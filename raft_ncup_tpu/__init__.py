@@ -1,0 +1,37 @@
+"""raft_ncup_tpu — a TPU-native (JAX/XLA/Pallas) optical-flow framework.
+
+A from-scratch rebuild of the capabilities of RAFT-NCUP (Eldesokey &
+Felsberg, VISAPP 2021; reference implementation in PyTorch), designed
+TPU-first:
+
+- NHWC layouts, bfloat16-friendly compute, static shapes, `lax.scan` over
+  the recurrent refinement iterations.
+- Correlation volume either materialized (fast at training resolutions) or
+  computed on the fly (memory-efficient at 1080p), with a Pallas kernel for
+  the fused lookup.
+- Data/spatial parallelism expressed with `jax.sharding.Mesh` + `jax.jit`
+  sharding annotations; XLA inserts the collectives (psum for gradients,
+  halo exchanges for spatially-sharded convolutions).
+
+Package map (mirrors the reference's capability inventory, SURVEY.md §2):
+
+- ``ops``        pure-function numerics: sampling, correlation, normalized
+                 convolution, resize/padding.
+- ``nn``         flax.linen modules: encoders, update blocks, NCUP stack.
+- ``models``     model orchestration (RAFT / RAFT-NCUP) as scan-based
+                 functional forward passes.
+- ``data``       dataset indexes, augmentation, flow file I/O, loaders.
+- ``training``   loss, optimizers/schedules, train state, training loop.
+- ``evaluation`` validation + leaderboard submission writers.
+- ``parallel``   mesh construction and sharded train/eval steps.
+- ``utils``      flow visualization, torch checkpoint import, profiling.
+"""
+
+__version__ = "0.1.0"
+
+from raft_ncup_tpu.config import (  # noqa: F401
+    DataConfig,
+    ModelConfig,
+    TrainConfig,
+    UpsamplerConfig,
+)

@@ -1,0 +1,221 @@
+"""Typed, immutable configuration for models, training, and data.
+
+The reference drives everything through argparse plus a reflective flag
+generator (reference: core/utils/args.py:8-114) and mutates ``args`` from
+inside model constructors (reference: core/raft.py:32-42). Here the full
+used surface of those flags (reference: train_raft_nc_things.sh:19-50) is
+captured as frozen dataclasses resolved *before* model construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+
+@dataclass(frozen=True)
+class UpsamplerConfig:
+    """Configuration of the final flow upsampler.
+
+    Mirrors the capability surface of the reference upsampler factory
+    (reference: core/upsampler.py:10-72) and the NConvUNet / weights-net
+    constructor flags (reference: train_raft_nc_things.sh:31-50).
+    """
+
+    # 'nconv' (NCUP), 'bilinear', 'pac', 'djif'. The RAFT baseline's convex
+    # upsampler is part of the model itself, not this registry — as in the
+    # reference (core/raft.py:73-84).
+    kind: str = "nconv"
+    # Upsampling factor applied by the upsampler itself. The NCUP path does
+    # nearest x2 first and NCUP x4 after (reference: core/raft_nc_dbl.py:110).
+    scale: int = 4
+    use_data_for_guidance: bool = True
+    channels_to_batch: bool = True
+    use_residuals: bool = False
+    est_on_high_res: bool = False
+
+    # --- interpolation (NConvUNet) net (reference: core/nconv_modules.py:25-92)
+    channels_multiplier: int = 2
+    num_downsampling: int = 1
+    encoder_filter_sz: int = 5
+    decoder_filter_sz: int = 3
+    out_filter_sz: int = 1
+    use_bias: bool = False
+    data_pooling: str = "conf_based"  # 'conf_based' | 'max_pooling'
+    shared_encoder: bool = True
+    use_double_conv: bool = False
+    pos_fn: str = "softplus"  # 'softplus' | 'exp' | 'sigmoid' | 'softmax'
+
+    # --- weights estimation net (reference: core/interp_weights_est.py:10-82)
+    weights_est_net: str = "simple"  # 'simple' | 'unet' | 'binary'
+    weights_est_num_ch: tuple[int, ...] = (64, 32)
+    weights_est_filter_sz: tuple[int, ...] = (3, 3, 1)
+    weights_est_dilation: tuple[int, ...] = (1, 1, 1)
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Model architecture configuration.
+
+    ``variant`` selects between the working model set of the reference:
+    'raft' (reference: core/raft.py) and 'raft_nc_dbl' (reference:
+    core/raft_nc_dbl.py). hidden/context dims and correlation geometry
+    follow reference: core/raft.py:29-39.
+    """
+
+    variant: str = "raft_nc_dbl"  # 'raft' | 'raft_nc_dbl'
+    small: bool = False
+    dropout: float = 0.0
+    # bfloat16 activations in encoders + update block (TPU analogue of the
+    # reference's CUDA AMP fp16 autocast, reference: core/raft.py:100-112).
+    # The correlation volume and the NCUP upsampler stay float32, as in the
+    # reference (fmaps cast .float() at core/raft.py:103-104; the upsampler
+    # call sits outside autocast at core/raft_nc_dbl.py:161).
+    mixed_precision: bool = False
+    # align_corners for the bilinear x8 upsampling used by the small/no-mask
+    # path (reference: core/raft.py:134; fixes the upflow8 signature bug
+    # noted in SURVEY.md §0.3).
+    align_corners: bool = True
+    corr_levels: int = 4
+    corr_radius: int = 4
+    # 'volume' materializes the all-pairs volume (reference semantics,
+    # core/corr.py:13-21); 'onthefly' recomputes windowed correlation per
+    # lookup (memory-efficient for 1080p); 'pallas' = fused TPU kernel.
+    corr_impl: str = "volume"
+    # Dataset the model is configured for. Controls BatchNorm in the NCUP
+    # weights-estimation net: ON for sintel, OFF otherwise (reference:
+    # core/upsampler.py:41-46 — and carried everywhere to avoid the
+    # reference's missing-``args.dataset`` crash, SURVEY.md §0.2).
+    dataset: str = "sintel"
+    # Freeze the RAFT trunk and train only the NCUP upsampler (reference:
+    # core/raft_nc_dbl.py:70-72).
+    freeze_raft: bool = False
+    upsampler: UpsamplerConfig = field(default_factory=UpsamplerConfig)
+
+    def __post_init__(self) -> None:
+        if self.variant not in ("raft", "raft_nc_dbl"):
+            raise ValueError(f"unknown model variant: {self.variant!r}")
+
+    @property
+    def hidden_dim(self) -> int:
+        return 96 if self.small else 128
+
+    @property
+    def context_dim(self) -> int:
+        return 64 if self.small else 128
+
+    @property
+    def fnet_dim(self) -> int:
+        return 128 if self.small else 256
+
+    @property
+    def resolved_corr_radius(self) -> int:
+        # reference: core/raft.py:29-39 — the model overrides the radius.
+        return 3 if self.small else self.corr_radius
+
+    @property
+    def corr_planes(self) -> int:
+        r = self.resolved_corr_radius
+        return self.corr_levels * (2 * r + 1) ** 2
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Training hyperparameters (reference: train.py:264-297 defaults and
+    the shipped launch scripts, e.g. train_raft_nc_things.sh:24-31)."""
+
+    name: str = "raft"
+    stage: str = "chairs"  # 'chairs' | 'things' | 'sintel' | 'kitti'
+    lr: float = 2e-5
+    num_steps: int = 100_000
+    batch_size: int = 6
+    image_size: tuple[int, int] = (384, 512)
+    iters: int = 12
+    wdecay: float = 5e-5
+    epsilon: float = 1e-8
+    clip: float = 1.0
+    gamma: float = 0.8
+    max_flow: float = 400.0
+    optimizer: str = "adamw"  # 'adamw' | 'adam'
+    scheduler: str = "cyclic"  # 'cyclic' (OneCycle-linear) | 'step'
+    scheduler_step: int = 20_000
+    add_noise: bool = False
+    validation: tuple[str, ...] = ()
+    val_freq: int = 5000
+    sum_freq: int = 100
+    seed: int = 1234
+    restore_ckpt: str | None = None
+    load_pretrained: str | None = None
+    checkpoint_dir: str = "checkpoints"
+    # parallelism: data-parallel size (None = all devices) and spatial size.
+    data_parallel: int | None = None
+    spatial_parallel: int = 1
+
+    @property
+    def total_schedule_steps(self) -> int:
+        # reference: train.py:93-94 — OneCycle over num_steps + 100.
+        return self.num_steps + 100
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Dataset roots and pipeline knobs (reference: core/datasets.py)."""
+
+    root_chairs: str = "datasets/FlyingChairs_release/data"
+    root_things: str = "datasets/FlyingThings3D"
+    root_sintel: str = "datasets/Sintel"
+    root_kitti: str = "datasets/KITTI"
+    root_hd1k: str = "datasets/HD1k"
+    chairs_split_file: str = "chairs_split.txt"
+    compressed_ft: bool = False
+    num_workers: int = 2
+    prefetch: int = 2
+    # When no dataset is present on disk, the loader can serve procedurally
+    # generated pairs so training/benchmarking still exercises the full path.
+    synthetic_ok: bool = False
+
+
+def _to_jsonable(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {k: _to_jsonable(v) for k, v in dataclasses.asdict(obj).items()}
+    if isinstance(obj, (list, tuple)):
+        return [_to_jsonable(v) for v in obj]
+    return obj
+
+
+def config_to_json(cfg: Any) -> str:
+    return json.dumps(_to_jsonable(cfg), indent=2, sort_keys=True)
+
+
+def _from_dict(cls: type, d: dict) -> Any:
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in d:
+            continue
+        v = d[f.name]
+        if dataclasses.is_dataclass(f.type) if isinstance(f.type, type) else False:
+            v = _from_dict(f.type, v)
+        elif f.name == "upsampler" and isinstance(v, dict):
+            v = _from_dict(UpsamplerConfig, v)
+        elif isinstance(v, list):
+            v = tuple(tuple(x) if isinstance(x, list) else x for x in v)
+        kwargs[f.name] = v
+    return cls(**kwargs)
+
+
+def model_config_from_json(s: str) -> ModelConfig:
+    return _from_dict(ModelConfig, json.loads(s))
+
+
+def small_model_config(variant: str = "raft", **overrides: Any) -> ModelConfig:
+    """RAFT-small preset (reference: core/raft.py:29-33)."""
+    return ModelConfig(variant=variant, small=True, **overrides)
+
+
+def flagship_config(dataset: str = "sintel", **overrides: Any) -> ModelConfig:
+    """The configuration every shipped reference script trains/evaluates:
+    raft_nc_dbl with the NCUP upsampler (reference:
+    train_raft_nc_things.sh:19-50)."""
+    return ModelConfig(variant="raft_nc_dbl", dataset=dataset, **overrides)

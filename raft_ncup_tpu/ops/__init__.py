@@ -1,0 +1,22 @@
+from raft_ncup_tpu.ops.geometry import (  # noqa: F401
+    adaptive_area_resize,
+    bilinear_resize_align_corners,
+    convex_upsample,
+    coords_grid,
+    grid_sample,
+    upsample_nearest,
+    upflow,
+)
+from raft_ncup_tpu.ops.corr import (  # noqa: F401
+    CorrPyramid,
+    build_corr_pyramid,
+    corr_lookup,
+    corr_lookup_onthefly,
+)
+from raft_ncup_tpu.ops.nconv import (  # noqa: F401
+    downsample_data_conf,
+    nconv2d,
+    positivity,
+    zero_stuff_upsample,
+)
+from raft_ncup_tpu.ops.padding import InputPadder  # noqa: F401

@@ -1,0 +1,180 @@
+"""All-pairs correlation volume + multi-scale windowed lookup.
+
+Two interchangeable implementations behind one signature:
+
+- ``build_corr_pyramid`` + ``corr_lookup`` materialize the O((HW)^2) volume
+  once per pair (reference semantics: core/corr.py:13-44). The einsum maps
+  straight onto the MXU; the 4-level pyramid is built with 2x2 average
+  pooling. Fast at training resolutions; the volume at 1/8 res of a 400x720
+  crop is ~100 MB/pair in fp32.
+
+- ``corr_lookup_onthefly`` never materializes the volume. Because the
+  lookup bilinearly samples the volume over its *second* pair of spatial
+  dims for a fixed query pixel, and correlation is linear in fmap2,
+  sample-then-dot == dot-then-sample:
+
+      bilerp_q <f1(p), f2(q)> = <f1(p), bilerp_q f2(q)>
+
+  (zero padding also agrees: an out-of-bounds tap contributes 0 either
+  way). So we bilinearly sample fmap2 at the 81 window taps and contract
+  with fmap1 on the fly, chunked over query rows to bound memory. This is
+  the memory-efficient path for 1080p / 32-iter inference where the full
+  volume would be several GB (SURVEY.md §5 "long-context" analogue).
+
+A fused Pallas kernel for the lookup lives in
+``raft_ncup_tpu.ops.corr_pallas`` and is validated against these.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from raft_ncup_tpu.ops.geometry import avg_pool2, grid_sample
+
+
+class CorrPyramid(NamedTuple):
+    """Materialized correlation pyramid.
+
+    ``levels[l]`` has shape (B, H1*W1, H2/2^l, W2/2^l): all-pairs
+    correlation between every query pixel of fmap1 and the (pooled) pixels
+    of fmap2, pre-divided by sqrt(dim) (reference: core/corr.py:47-55).
+    """
+
+    levels: tuple[jax.Array, ...]
+    query_hw: tuple[int, int]
+
+
+def _delta_window(radius: int, dtype=jnp.float32) -> jax.Array:
+    """(K, K, 2) window offsets, K = 2r+1.
+
+    Tap (i, j) offsets the *x* coordinate by (i - r) and the *y* coordinate
+    by (j - r): the reference builds ``delta`` from ``meshgrid(dy, dx)`` and
+    adds it to (x, y)-ordered centroids (core/corr.py:31-37), so the first
+    window axis varies the x offset. Preserving this ordering keeps the
+    lookup's output channel order — and therefore motion-encoder weights —
+    compatible with reference checkpoints.
+    """
+    d = jnp.arange(-radius, radius + 1, dtype=dtype)
+    di, dj = jnp.meshgrid(d, d, indexing="ij")
+    return jnp.stack([di, dj], axis=-1)  # [..., 0] -> x offset, [..., 1] -> y
+
+
+def build_corr_pyramid(
+    fmap1: jax.Array, fmap2: jax.Array, num_levels: int = 4
+) -> CorrPyramid:
+    """Compute the all-pairs correlation volume and its average pyramid.
+
+    Args:
+      fmap1, fmap2: (B, H, W, C) feature maps (cast to float32 like the
+        reference's ``fmap.float()`` at core/raft.py:103-104).
+    """
+    B, H, W, C = fmap1.shape
+    f1 = fmap1.reshape(B, H * W, C).astype(jnp.float32)
+    f2 = fmap2.reshape(B, H * W, C).astype(jnp.float32)
+    corr = jnp.einsum("bxc,byc->bxy", f1, f2) / math.sqrt(C)
+    corr = corr.reshape(B, H * W, H, W)
+
+    levels = [corr]
+    for _ in range(num_levels - 1):
+        n, q, h, w = levels[-1].shape
+        pooled = avg_pool2(levels[-1].reshape(n * q, h, w, 1))
+        levels.append(pooled.reshape(n, q, pooled.shape[1], pooled.shape[2]))
+    return CorrPyramid(levels=tuple(levels), query_hw=(H, W))
+
+
+def corr_lookup(pyramid: CorrPyramid, coords: jax.Array, radius: int) -> jax.Array:
+    """Sample (2r+1)^2 windows around ``coords / 2^l`` at every level.
+
+    Reference: core/corr.py:23-44.
+
+    Args:
+      pyramid: from :func:`build_corr_pyramid`.
+      coords: (B, H, W, 2) query positions in fmap2 pixel coordinates.
+    Returns:
+      (B, H, W, L * (2r+1)^2) float32, level-major then window-tap order.
+    """
+    B, H, W, _ = coords.shape
+    K = 2 * radius + 1
+    delta = _delta_window(radius)  # (K, K, 2)
+
+    out = []
+    for lvl, corr in enumerate(pyramid.levels):
+        _, _, Hl, Wl = corr.shape
+        centroid = coords.reshape(B, H * W, 1, 1, 2) / (2**lvl)
+        coords_lvl = centroid + delta[None, None]  # (B, HW, K, K, 2)
+        # Fold queries into the batch dim for the gather.
+        vol = corr.reshape(B * H * W, Hl, Wl, 1)
+        c = coords_lvl.reshape(B * H * W, K, K, 2)
+        sampled = grid_sample(vol, c)  # (B*HW, K, K, 1)
+        out.append(sampled.reshape(B, H, W, K * K))
+    return jnp.concatenate(out, axis=-1)
+
+
+def _pool_fmap_pyramid(fmap2: jax.Array, num_levels: int) -> list[jax.Array]:
+    """Average-pool fmap2 into a pyramid.
+
+    Pooling the *features* then correlating equals pooling the correlation
+    volume (reference pools the volume, core/corr.py:19-21) because the
+    2x2 mean acts on the fmap2 axes only and correlation is linear in
+    fmap2.
+    """
+    levels = [fmap2]
+    for _ in range(num_levels - 1):
+        levels.append(avg_pool2(levels[-1]))
+    return levels
+
+
+def corr_lookup_onthefly(
+    fmap1: jax.Array,
+    fmap2: jax.Array,
+    coords: jax.Array,
+    radius: int,
+    num_levels: int = 4,
+    row_chunk: int = 8,
+) -> jax.Array:
+    """Windowed correlation lookup without materializing the volume.
+
+    Equivalent to ``corr_lookup(build_corr_pyramid(f1, f2), coords, r)`` up
+    to float associativity; O(B * HW * L * K^2 * C) compute per call but
+    O(B * row_chunk * W * K^2 * C) peak memory.
+
+    Args:
+      fmap1, fmap2: (B, H, W, C).
+      coords: (B, H, W, 2).
+      row_chunk: query rows processed per scan step (H % row_chunk may be
+        nonzero; handled by padding).
+    """
+    B, H, W, C = fmap1.shape
+    K = 2 * radius + 1
+    scale = 1.0 / math.sqrt(C)
+    f2_levels = _pool_fmap_pyramid(fmap2.astype(jnp.float32), num_levels)
+    f1 = fmap1.astype(jnp.float32)
+    delta = _delta_window(radius)
+
+    pad_rows = (-H) % row_chunk
+    f1p = jnp.pad(f1, ((0, 0), (0, pad_rows), (0, 0), (0, 0)))
+    cp = jnp.pad(coords.astype(jnp.float32), ((0, 0), (0, pad_rows), (0, 0), (0, 0)))
+    n_chunks = (H + pad_rows) // row_chunk
+
+    f1c = f1p.reshape(B, n_chunks, row_chunk, W, C).transpose(1, 0, 2, 3, 4)
+    cc = cp.reshape(B, n_chunks, row_chunk, W, 2).transpose(1, 0, 2, 3, 4)
+
+    def chunk_fn(carry, xs):
+        f1_chunk, coords_chunk = xs  # (B, rc, W, C), (B, rc, W, 2)
+        per_level = []
+        for lvl in range(num_levels):
+            centroid = coords_chunk[:, :, :, None, None, :] / (2**lvl)
+            taps = centroid + delta[None, None, None]  # (B, rc, W, K, K, 2)
+            sampled = grid_sample(f2_levels[lvl], taps)  # (B, rc, W, K, K, C)
+            corr = jnp.einsum("brwijc,brwc->brwij", sampled, f1_chunk) * scale
+            per_level.append(corr.reshape(*corr.shape[:3], K * K))
+        return carry, jnp.concatenate(per_level, axis=-1)
+
+    _, chunks = jax.lax.scan(chunk_fn, None, (f1c, cc))
+    # (n_chunks, B, rc, W, L*K*K) -> (B, H, W, L*K*K)
+    out = chunks.transpose(1, 0, 2, 3, 4).reshape(B, H + pad_rows, W, -1)
+    return out[:, :H]

@@ -1,0 +1,203 @@
+"""Pure-function geometry/sampling ops (NHWC, TPU-friendly).
+
+These match the sampling semantics of the reference exactly — in
+particular PyTorch's ``grid_sample(align_corners=True, padding='zeros')``
+(reference: core/utils/utils.py:59-73) and the convex 8x upsampling built
+from softmax masks + unfold (reference: core/raft.py:73-84) — because the
+sub-pixel behavior of these ops silently changes EPE.
+
+Everything here is shape-polymorphic, jit-safe (static shapes in, static
+shapes out) and differentiable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def coords_grid(batch: int, ht: int, wd: int, dtype=jnp.float32) -> jax.Array:
+    """Pixel-coordinate grid, shape (B, H, W, 2) with [..., 0]=x, [..., 1]=y.
+
+    NHWC analogue of reference: core/utils/utils.py:76-79 (which returns
+    (B, 2, H, W) with channel 0 = x).
+    """
+    y, x = jnp.meshgrid(
+        jnp.arange(ht, dtype=dtype), jnp.arange(wd, dtype=dtype), indexing="ij"
+    )
+    grid = jnp.stack([x, y], axis=-1)
+    return jnp.broadcast_to(grid[None], (batch, ht, wd, 2))
+
+
+def grid_sample(img: jax.Array, coords: jax.Array) -> jax.Array:
+    """Bilinear sampling at pixel coordinates with zero padding.
+
+    Matches ``F.grid_sample(mode='bilinear', padding_mode='zeros',
+    align_corners=True)`` after the pixel->normalized->pixel round trip of
+    the reference wrapper (core/utils/utils.py:59-73): each of the four
+    corner taps contributes 0 iff that *tap* is out of bounds.
+
+    Args:
+      img:    (B, H, W, C)
+      coords: (B, ..., 2) pixel coordinates; [..., 0] = x in [0, W-1],
+              [..., 1] = y in [0, H-1] (out-of-range allowed).
+
+    Returns:
+      (B, ..., C) sampled values.
+    """
+    B, H, W, C = img.shape
+    x = coords[..., 0].astype(img.dtype)
+    y = coords[..., 1].astype(img.dtype)
+
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    dx = x - x0
+    dy = y - y0
+
+    flat_img = img.reshape(B, H * W, C)
+    batch_shape = x.shape  # (B, ...)
+
+    out = jnp.zeros(batch_shape + (C,), dtype=img.dtype)
+    taps = (
+        (x0, y0, (1.0 - dx) * (1.0 - dy)),
+        (x0 + 1.0, y0, dx * (1.0 - dy)),
+        (x0, y0 + 1.0, (1.0 - dx) * dy),
+        (x0 + 1.0, y0 + 1.0, dx * dy),
+    )
+    for tx, ty, w in taps:
+        valid = (tx >= 0) & (tx <= W - 1) & (ty >= 0) & (ty <= H - 1)
+        xi = jnp.clip(tx, 0, W - 1).astype(jnp.int32)
+        yi = jnp.clip(ty, 0, H - 1).astype(jnp.int32)
+        flat_idx = (yi * W + xi).reshape(B, -1)
+        v = jnp.take_along_axis(flat_img, flat_idx[..., None], axis=1)
+        v = v.reshape(batch_shape + (C,))
+        out = out + jnp.where(valid, w, 0.0)[..., None] * v
+    return out
+
+
+def bilinear_resize_align_corners(x: jax.Array, out_hw: tuple[int, int]) -> jax.Array:
+    """Bilinear resize with ``align_corners=True`` semantics.
+
+    Matches ``F.interpolate(mode='bilinear', align_corners=True)`` used by
+    the x8 flow upsampling on the mask-free path (reference:
+    core/utils/utils.py:82-84) and the Bilinear upsampler baseline
+    (reference: core/upsampler.py:213-220). ``jax.image.resize`` uses
+    half-pixel centers, so this is built on :func:`grid_sample` instead.
+
+    Args:
+      x: (B, H, W, C).
+      out_hw: (H_out, W_out).
+    """
+    B, H, W, C = x.shape
+    oh, ow = out_hw
+
+    def axis_coords(n_in: int, n_out: int) -> jax.Array:
+        if n_out == 1:
+            return jnp.zeros((1,), dtype=x.dtype)
+        scale = (n_in - 1) / (n_out - 1)
+        return jnp.arange(n_out, dtype=x.dtype) * scale
+
+    ys = axis_coords(H, oh)
+    xs = axis_coords(W, ow)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    coords = jnp.broadcast_to(
+        jnp.stack([gx, gy], axis=-1)[None], (B, oh, ow, 2)
+    )
+    return grid_sample(x, coords)
+
+
+def upflow(flow: jax.Array, factor: int = 8, align_corners: bool = True) -> jax.Array:
+    """Bilinear flow upsampling: resize x ``factor`` and scale values.
+
+    Reference: core/utils/utils.py:82-84 (with the explicit
+    ``align_corners`` the reference's call site expected, SURVEY.md §0.3).
+    """
+    B, H, W, _ = flow.shape
+    if align_corners:
+        up = bilinear_resize_align_corners(flow, (H * factor, W * factor))
+    else:
+        up = jax.image.resize(flow, (B, H * factor, W * factor, 2), "bilinear")
+    return factor * up
+
+
+def upsample_nearest(x: jax.Array, factor: int) -> jax.Array:
+    """Nearest-neighbor integer upsampling (``F.interpolate(mode='nearest')``
+    for integer factors: out[i] = in[i // factor])."""
+    x = jnp.repeat(x, factor, axis=1)
+    x = jnp.repeat(x, factor, axis=2)
+    return x
+
+
+def adaptive_area_resize(x: jax.Array, out_hw: tuple[int, int]) -> jax.Array:
+    """``F.interpolate(mode='area')`` (= adaptive average pooling) for sizes
+    related by integer ratios — the only shapes the reference exercises
+    (guidance resize at core/upsampler.py:150: H/8 -> H/4, i.e. 2x up, which
+    under area interpolation is nearest replication; and integer-factor
+    downsampling elsewhere)."""
+    B, H, W, C = x.shape
+    oh, ow = out_hw
+    if oh == H and ow == W:
+        return x
+    if oh >= H and ow >= W:
+        if oh % H == 0 and ow % W == 0:
+            return jnp.repeat(jnp.repeat(x, oh // H, axis=1), ow // W, axis=2)
+        raise NotImplementedError("area upsample only for integer factors")
+    if H % oh == 0 and W % ow == 0:
+        fh, fw = H // oh, W // ow
+        x = x.reshape(B, oh, fh, ow, fw, C)
+        return x.mean(axis=(2, 4))
+    raise NotImplementedError("area resize only for integer ratios")
+
+
+def avg_pool2(x: jax.Array) -> jax.Array:
+    """2x2 stride-2 average pooling, VALID (odd trailing row/col dropped),
+    matching ``F.avg_pool2d(x, 2, stride=2)`` used for the correlation
+    pyramid (reference: core/corr.py:20). x: (B, H, W, C)."""
+    B, H, W, C = x.shape
+    h2, w2 = H // 2, W // 2
+    x = x[:, : h2 * 2, : w2 * 2, :].reshape(B, h2, 2, w2, 2, C)
+    return x.mean(axis=(2, 4))
+
+
+def extract_3x3_patches(x: jax.Array) -> jax.Array:
+    """3x3 patch extraction with zero padding 1, matching the tap ordering
+    of ``F.unfold(x, [3, 3], padding=1)``: tap k = ky * 3 + kx reads input
+    pixel (h - 1 + ky, w - 1 + kx).
+
+    Args:
+      x: (B, H, W, C).
+    Returns:
+      (B, H, W, 9, C).
+    """
+    B, H, W, C = x.shape
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    rows = [
+        xp[:, ky : ky + H, kx : kx + W, :] for ky in range(3) for kx in range(3)
+    ]
+    return jnp.stack(rows, axis=3)
+
+
+def convex_upsample(flow: jax.Array, mask: jax.Array, factor: int = 8) -> jax.Array:
+    """RAFT's learned convex-combination upsampling.
+
+    Reference: core/raft.py:73-84. The mask channel layout matches the
+    reference's ``view(N, 1, 9, f, f, H, W)`` on a (9*f*f)-channel tensor:
+    channel c = k * f * f + i * f + j, where k indexes the 3x3 neighborhood
+    (row-major) and (i, j) the sub-pixel position. Keeping this layout makes
+    reference checkpoints importable weight-for-weight.
+
+    Args:
+      flow: (B, H, W, 2) low-res flow.
+      mask: (B, H, W, 9 * factor * factor) unnormalized mask logits.
+    Returns:
+      (B, H*factor, W*factor, 2) upsampled flow with values scaled by
+      ``factor``.
+    """
+    B, H, W, _ = flow.shape
+    f = factor
+    m = mask.reshape(B, H, W, 9, f, f)
+    m = jax.nn.softmax(m, axis=3)
+    patches = extract_3x3_patches(factor * flow)  # (B, H, W, 9, 2)
+    up = jnp.einsum("bhwkij,bhwkc->bhwijc", m, patches)  # (B, H, W, f, f, 2)
+    up = up.transpose(0, 1, 3, 2, 4, 5).reshape(B, H * f, W * f, 2)
+    return up

@@ -1,0 +1,140 @@
+"""Normalized-convolution primitives (the math under NCUP).
+
+The core op is a pair of convolutions sharing one kernel with non-negative
+weights (reference: core/nconv_modules.py:164-199):
+
+    out  = conv(data * conf, w) / (conv(conf, w) + eps) [+ bias]
+    cout = conv(conf, w) / sum(w)        # propagated confidence
+
+plus the confidence-aware downsampling (max-pool confidence, gather data at
+the confidence argmax, reference: core/nconv_modules.py:94-104) and the
+zero-stuffing scatter that lifts low-res data onto the high-res grid
+(reference: core/upsampler.py:208).
+
+Non-negativity is enforced by a softplus reparameterization — the
+functional analogue of the reference's forward-pre-hook ``EnforcePos``
+machinery (core/nconv_modules.py:218-269); no hooks needed in JAX: the
+positive weight is simply recomputed from the raw parameter every call.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def positivity(raw: jax.Array, pos_fn: str = "softplus") -> jax.Array:
+    """Map a raw parameter to a non-negative kernel.
+
+    Reference: core/nconv_modules.py:254-269 (``_pos``). The softplus uses
+    beta=10: softplus_10(x) = log(1 + exp(10 x)) / 10.
+    """
+    pos_fn = pos_fn.lower()
+    if pos_fn == "softplus":
+        return jax.nn.softplus(10.0 * raw) / 10.0
+    if pos_fn == "exp":
+        return jnp.exp(raw)
+    if pos_fn == "sigmoid":
+        return jax.nn.sigmoid(raw)
+    if pos_fn == "softmax":
+        # Per-output-channel softmax over (kh, kw, in).
+        o = raw.shape[-1]
+        flat = raw.reshape(-1, o)
+        return jax.nn.softmax(flat, axis=0).reshape(raw.shape)
+    raise ValueError(f"unknown pos_fn: {pos_fn!r}")
+
+
+def nconv2d(
+    data: jax.Array,
+    conf: jax.Array,
+    weight: jax.Array,
+    bias: jax.Array | None = None,
+    *,
+    eps: float = 1e-20,
+    stride: int = 1,
+    groups: int = 1,
+    propagate_conf: bool = True,
+) -> tuple[jax.Array, jax.Array | None]:
+    """Normalized convolution with confidence propagation.
+
+    Args:
+      data, conf: (B, H, W, Cin) NHWC.
+      weight: (kh, kw, Cin/groups, Cout) HWIO, already non-negative (apply
+        :func:`positivity` first).
+      bias: (Cout,) or None.
+    Returns:
+      (out, conf_out), both (B, H', W', Cout); SAME padding for odd kernels
+      (reference pads kernel//2, core/nconv_modules.py:143-144).
+    """
+    kh, kw = weight.shape[0], weight.shape[1]
+    pad = ((kh // 2, kh // 2), (kw // 2, kw // 2))
+    dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, ("NHWC", "HWIO", "NHWC"))
+
+    def conv(x: jax.Array) -> jax.Array:
+        return jax.lax.conv_general_dilated(
+            x,
+            weight,
+            window_strides=(stride, stride),
+            padding=pad,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+
+    denom = conv(conf)
+    nomin = conv(data * conf)
+    out = nomin / (denom + eps)
+    if bias is not None:
+        out = out + bias
+    if propagate_conf:
+        # conf_out = conv(conf) / sum_k(w) per output channel
+        # (reference: core/nconv_modules.py:180-194).
+        s = weight.sum(axis=(0, 1, 2))
+        conf_out = denom / s
+    else:
+        conf_out = None
+    return out, conf_out
+
+
+def downsample_data_conf(
+    data: jax.Array, conf: jax.Array, pooling_type: str = "conf_based"
+) -> tuple[jax.Array, jax.Array]:
+    """2x2 stride-2 confidence-aware downsampling.
+
+    Max-pools the confidence and gathers data at the confidence argmax
+    ('conf_based') or max-pools data directly ('max_pooling'); the pooled
+    confidence is divided by 4 (the Jacobian of the scale change —
+    reference: core/nconv_modules.py:94-104).
+
+    Args:
+      data, conf: (B, H, W, C) with H, W even.
+    """
+    B, H, W, C = conf.shape
+    cb = conf.reshape(B, H // 2, 2, W // 2, 2, C).transpose(0, 1, 3, 5, 2, 4)
+    cb = cb.reshape(B, H // 2, W // 2, C, 4)
+    conf_ds = cb.max(axis=-1) / 4.0
+    if pooling_type == "conf_based":
+        idx = cb.argmax(axis=-1)
+        db = data.reshape(B, H // 2, 2, W // 2, 2, C).transpose(0, 1, 3, 5, 2, 4)
+        db = db.reshape(B, H // 2, W // 2, C, 4)
+        data_ds = jnp.take_along_axis(db, idx[..., None], axis=-1)[..., 0]
+    elif pooling_type == "max_pooling":
+        db = data.reshape(B, H // 2, 2, W // 2, 2, C).transpose(0, 1, 3, 5, 2, 4)
+        data_ds = db.reshape(B, H // 2, W // 2, C, 4).max(axis=-1)
+    else:
+        raise ValueError(f"unknown pooling_type: {pooling_type!r}")
+    return data_ds, conf_ds
+
+
+def zero_stuff_upsample(x: jax.Array, scale_h: int, scale_w: int) -> jax.Array:
+    """Scatter low-res samples into a zeroed high-res grid at stride
+    centers: ``out[:, sH//2::sH, sW//2::sW] = x`` (reference:
+    core/upsampler.py:179-210).
+
+    Args:
+      x: (B, H, W, C).
+    Returns:
+      (B, H*scale_h, W*scale_w, C) zeros except at the stuffed positions.
+    """
+    B, H, W, C = x.shape
+    out = jnp.zeros((B, H * scale_h, W * scale_w, C), dtype=x.dtype)
+    return out.at[:, scale_h // 2 :: scale_h, scale_w // 2 :: scale_w, :].set(x)

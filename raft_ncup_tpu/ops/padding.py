@@ -1,0 +1,40 @@
+"""Input padding to stride-8-divisible shapes (reference:
+core/utils/utils.py:7-25)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class InputPadder:
+    """Pads NHWC images so H and W are divisible by 8 (replicate padding).
+
+    mode='sintel' centers the vertical padding; mode='kitti' puts all
+    vertical padding below the image (the reference's torch pad spec
+    ``[wl, wr, 0, pad_ht]`` is (left, right, top, bottom)). Horizontal
+    padding is centered in both modes.
+    """
+
+    def __init__(self, dims: tuple[int, ...], mode: str = "sintel"):
+        # dims is NHWC (B, H, W, C) or HWC (H, W, C).
+        if len(dims) == 4:
+            self.ht, self.wd = dims[1], dims[2]
+        else:
+            self.ht, self.wd = dims[0], dims[1]
+        pad_ht = (((self.ht // 8) + 1) * 8 - self.ht) % 8
+        pad_wd = (((self.wd // 8) + 1) * 8 - self.wd) % 8
+        wpad = (pad_wd // 2, pad_wd - pad_wd // 2)
+        if mode == "sintel":
+            self._pad = ((pad_ht // 2, pad_ht - pad_ht // 2), wpad)
+        else:
+            self._pad = ((0, pad_ht), wpad)
+
+    def pad(self, *inputs: jax.Array) -> list[jax.Array]:
+        spec = ((0, 0), self._pad[0], self._pad[1], (0, 0))
+        return [jnp.pad(x, spec, mode="edge") for x in inputs]
+
+    def unpad(self, x: jax.Array) -> jax.Array:
+        (t, b), (l, r) = self._pad
+        ht, wd = x.shape[-3], x.shape[-2]
+        return x[..., t : ht - b, l : wd - r, :]

@@ -1,0 +1,39 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-chip sharding (data x spatial meshes) is tested on virtual CPU
+devices, mirroring how the driver dry-runs the multi-chip path
+(``xla_force_host_platform_device_count``).
+"""
+
+import os
+
+# Overwrite, not setdefault: the axon TPU boot hook (sitecustomize) sets
+# JAX_PLATFORMS=axon for every interpreter; tests run on virtual CPU
+# devices so the sharded paths can be exercised without a pod.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The axon boot hook may have imported jax already (baking JAX_PLATFORMS=axon
+# into jax.config before this file runs), so update the config directly too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "reference: tests that import the read-only reference repo"
+    )
+    config.addinivalue_line("markers", "slow: long-running tests")

@@ -1,0 +1,110 @@
+"""Correlation volume + lookup vs the reference math (torch oracle) and
+cross-implementation equivalence (volume vs on-the-fly)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from raft_ncup_tpu.ops import (
+    build_corr_pyramid,
+    coords_grid,
+    corr_lookup,
+    corr_lookup_onthefly,
+)
+
+
+def torch_corr_block(fmap1, fmap2, num_levels=4, radius=4):
+    """Reimplementation of the reference CorrBlock (core/corr.py:6-55) as a
+    test oracle (NCHW torch tensors in, (B, L*K*K, H, W) out)."""
+    batch, dim, ht, wd = fmap1.shape
+    f1 = fmap1.view(batch, dim, ht * wd)
+    f2 = fmap2.view(batch, dim, ht * wd)
+    corr = torch.matmul(f1.transpose(1, 2), f2)
+    corr = corr.view(batch * ht * wd, 1, ht, wd) / torch.sqrt(
+        torch.tensor(dim).float()
+    )
+    pyramid = [corr]
+    for _ in range(num_levels - 1):
+        corr = F.avg_pool2d(corr, 2, stride=2)
+        pyramid.append(corr)
+
+    def lookup(coords):
+        r = radius
+        coords = coords.permute(0, 2, 3, 1)
+        batch, h1, w1, _ = coords.shape
+        out_pyramid = []
+        for i, corr in enumerate(pyramid):
+            dx = torch.linspace(-r, r, 2 * r + 1)
+            dy = torch.linspace(-r, r, 2 * r + 1)
+            delta = torch.stack(torch.meshgrid(dy, dx, indexing="ij"), axis=-1)
+            centroid_lvl = coords.reshape(batch * h1 * w1, 1, 1, 2) / 2**i
+            delta_lvl = delta.view(1, 2 * r + 1, 2 * r + 1, 2)
+            coords_lvl = centroid_lvl + delta_lvl
+            H, W = corr.shape[-2:]
+            xgrid, ygrid = coords_lvl.split([1, 1], dim=-1)
+            xgrid = 2 * xgrid / (W - 1) - 1
+            ygrid = 2 * ygrid / (H - 1) - 1
+            grid = torch.cat([xgrid, ygrid], dim=-1)
+            sampled = F.grid_sample(corr, grid, align_corners=True)
+            out_pyramid.append(sampled.view(batch, h1, w1, -1))
+        out = torch.cat(out_pyramid, dim=-1)
+        return out.permute(0, 3, 1, 2).contiguous().float()
+
+    return lookup
+
+
+@pytest.mark.parametrize("radius", [3, 4])
+def test_corr_volume_lookup_matches_torch(radius):
+    # H, W large enough that the deepest pyramid level is > 1 pixel (the
+    # reference's coordinate normalization divides by W-1).
+    rng = np.random.default_rng(0)
+    B, H, W, C = 2, 16, 24, 16
+    f1 = rng.standard_normal((B, H, W, C)).astype(np.float32)
+    f2 = rng.standard_normal((B, H, W, C)).astype(np.float32)
+    coords = (
+        coords_grid(B, H, W)
+        + rng.uniform(-3, 3, size=(B, H, W, 2)).astype(np.float32)
+    )
+
+    pyr = build_corr_pyramid(jnp.asarray(f1), jnp.asarray(f2), num_levels=4)
+    ours = np.asarray(corr_lookup(pyr, jnp.asarray(coords), radius))
+
+    t1 = torch.from_numpy(f1).permute(0, 3, 1, 2)
+    t2 = torch.from_numpy(f2).permute(0, 3, 1, 2)
+    tcoords = torch.from_numpy(np.asarray(coords)).permute(0, 3, 1, 2)
+    lookup = torch_corr_block(t1, t2, num_levels=4, radius=radius)
+    theirs = lookup(tcoords).permute(0, 2, 3, 1).numpy()
+
+    np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+
+def test_onthefly_matches_volume():
+    rng = np.random.default_rng(1)
+    B, H, W, C = 1, 16, 22, 8
+    f1 = jnp.asarray(rng.standard_normal((B, H, W, C)).astype(np.float32))
+    f2 = jnp.asarray(rng.standard_normal((B, H, W, C)).astype(np.float32))
+    coords = coords_grid(B, H, W) + jnp.asarray(
+        rng.uniform(-4, 4, size=(B, H, W, 2)).astype(np.float32)
+    )
+    pyr = build_corr_pyramid(f1, f2, num_levels=4)
+    vol = np.asarray(corr_lookup(pyr, coords, radius=4))
+    otf = np.asarray(
+        corr_lookup_onthefly(f1, f2, coords, radius=4, num_levels=4, row_chunk=3)
+    )
+    np.testing.assert_allclose(vol, otf, atol=2e-4)
+
+
+def test_corr_pyramid_shapes():
+    B, H, W, C = 2, 16, 24, 4
+    f = jnp.zeros((B, H, W, C))
+    pyr = build_corr_pyramid(f, f, num_levels=4)
+    assert [lvl.shape for lvl in pyr.levels] == [
+        (B, H * W, 16, 24),
+        (B, H * W, 8, 12),
+        (B, H * W, 4, 6),
+        (B, H * W, 2, 3),
+    ]
+    out = corr_lookup(pyr, coords_grid(B, H, W), radius=4)
+    assert out.shape == (B, H, W, 4 * 81)

@@ -1,0 +1,125 @@
+"""Normalized-convolution primitive tests against a torch oracle mirroring
+core/nconv_modules.py:164-199."""
+
+import jax.numpy as jnp
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+from raft_ncup_tpu.ops import (
+    downsample_data_conf,
+    nconv2d,
+    positivity,
+    zero_stuff_upsample,
+)
+
+
+def torch_nconv(data, conf, weight, bias=None, eps=1e-20):
+    """Oracle for the reference NConv2d forward (NCHW, OIHW weight)."""
+    pad = weight.shape[-1] // 2
+    denom = F.conv2d(conf, weight, None, 1, pad)
+    nomin = F.conv2d(data * conf, weight, None, 1, pad)
+    out = nomin / (denom + eps)
+    if bias is not None:
+        out = out + bias.view(1, -1, 1, 1)
+    s = weight.reshape(weight.shape[0], -1).sum(dim=-1)
+    cout = denom / s.view(1, -1, 1, 1)
+    return out, cout
+
+
+def test_nconv2d_matches_torch():
+    rng = np.random.default_rng(0)
+    B, H, W = 2, 10, 12
+    cin, cout, k = 2, 3, 5
+    data = rng.standard_normal((B, H, W, cin)).astype(np.float32)
+    conf = rng.uniform(0, 1, (B, H, W, cin)).astype(np.float32)
+    w = rng.uniform(0.1, 2.0, (k, k, cin, cout)).astype(np.float32)
+    b = rng.standard_normal((cout,)).astype(np.float32)
+
+    ours_out, ours_conf = nconv2d(
+        jnp.asarray(data), jnp.asarray(conf), jnp.asarray(w), jnp.asarray(b)
+    )
+
+    tw = torch.from_numpy(w).permute(3, 2, 0, 1)  # HWIO -> OIHW
+    t_out, t_conf = torch_nconv(
+        torch.from_numpy(data).permute(0, 3, 1, 2),
+        torch.from_numpy(conf).permute(0, 3, 1, 2),
+        tw,
+        torch.from_numpy(b),
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours_out), t_out.permute(0, 2, 3, 1).numpy(), atol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours_conf), t_conf.permute(0, 2, 3, 1).numpy(), atol=1e-5
+    )
+
+
+def test_positivity_softplus_matches_torch_beta10():
+    x = np.linspace(-3, 3, 13).astype(np.float32)
+    ours = np.asarray(positivity(jnp.asarray(x), "softplus"))
+    theirs = F.softplus(torch.from_numpy(x), beta=10).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-6)
+    assert (ours >= 0).all()
+
+
+def test_downsample_conf_based_matches_torch():
+    rng = np.random.default_rng(0)
+    B, H, W, C = 2, 8, 6, 3
+    data = rng.standard_normal((B, H, W, C)).astype(np.float32)
+    conf = rng.uniform(0, 1, (B, H, W, C)).astype(np.float32)
+
+    d_ds, c_ds = downsample_data_conf(
+        jnp.asarray(data), jnp.asarray(conf), "conf_based"
+    )
+
+    tconf = torch.from_numpy(conf).permute(0, 3, 1, 2)
+    tdata = torch.from_numpy(data).permute(0, 3, 1, 2)
+    c_ref, idx = F.max_pool2d(tconf, 2, 2, return_indices=True)
+    c_ref = c_ref / 4
+    flat = tdata.flatten(start_dim=2)
+    d_ref = flat.gather(dim=2, index=idx.flatten(start_dim=2)).view_as(idx)
+
+    np.testing.assert_allclose(
+        np.asarray(c_ds), c_ref.permute(0, 2, 3, 1).numpy(), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(d_ds), d_ref.permute(0, 2, 3, 1).numpy(), atol=1e-6
+    )
+
+
+def test_downsample_max_pooling():
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((1, 4, 4, 2)).astype(np.float32)
+    conf = rng.uniform(0, 1, (1, 4, 4, 2)).astype(np.float32)
+    d_ds, c_ds = downsample_data_conf(
+        jnp.asarray(data), jnp.asarray(conf), "max_pooling"
+    )
+    t = torch.from_numpy(data).permute(0, 3, 1, 2)
+    ref = F.max_pool2d(t, 2, 2).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(np.asarray(d_ds), ref, atol=1e-6)
+
+
+def test_zero_stuff_positions():
+    x = jnp.ones((1, 3, 3, 2))
+    out = np.asarray(zero_stuff_upsample(x, 4, 4))
+    assert out.shape == (1, 12, 12, 2)
+    # Nonzero exactly at rows/cols 2, 6, 10 (sH//2::sH).
+    nz = np.nonzero(out[0, :, :, 0])
+    assert set(nz[0]) == {2, 6, 10} and set(nz[1]) == {2, 6, 10}
+    assert out.sum() == 2 * 9
+
+
+def test_nconv_gradient_flows():
+    """The divide makes gradients fragile; check they're finite."""
+    import jax
+
+    def loss_fn(w_raw):
+        w = positivity(w_raw)
+        data = jnp.ones((1, 6, 6, 1))
+        conf = jnp.full((1, 6, 6, 1), 0.5)
+        out, _ = nconv2d(data, conf, w)
+        return (out**2).sum()
+
+    g = jax.grad(loss_fn)(jnp.full((3, 3, 1, 2), 2.0))
+    assert np.isfinite(np.asarray(g)).all()
