@@ -195,9 +195,7 @@ def _from_dict(cls: type, d: dict) -> Any:
         if f.name not in d:
             continue
         v = d[f.name]
-        if dataclasses.is_dataclass(f.type) if isinstance(f.type, type) else False:
-            v = _from_dict(f.type, v)
-        elif f.name == "upsampler" and isinstance(v, dict):
+        if f.name == "upsampler" and isinstance(v, dict):
             v = _from_dict(UpsamplerConfig, v)
         elif isinstance(v, list):
             v = tuple(tuple(x) if isinstance(x, list) else x for x in v)

@@ -1,0 +1,1 @@
+from raft_ncup_tpu.models.raft import RAFT, get_model  # noqa: F401

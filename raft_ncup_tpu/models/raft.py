@@ -1,0 +1,324 @@
+"""RAFT / RAFT-NCUP model orchestration, TPU-first.
+
+Rather than one monolithic module, the model is a bundle of linen
+components (fnet/cnet/update_block/upsampler) plus a pure-JAX forward that
+wires them together. This keeps the recurrent refinement a plain
+``jax.lax.scan`` — one compiled iteration body regardless of iteration
+count — with the GRU hidden state, query coordinates and (when BatchNorm
+lives inside the upsampler) mutable batch statistics as the scan carry.
+Gradient rematerialization wraps the body during training so the 12
+full-resolution NCUP passes don't hold live activations.
+
+Reference call structure: core/raft.py:87-143 (baseline) and
+core/raft_nc_dbl.py:115-173 (NCUP variant: mask head removed, per-iter
+nearest x2 -> NCUP x4 -> values x8).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_ncup_tpu.config import ModelConfig
+from raft_ncup_tpu.nn.extractor import Encoder
+from raft_ncup_tpu.nn.update import BasicUpdateBlock, SmallUpdateBlock
+from raft_ncup_tpu.nn.upsampler import build_upsampler
+from raft_ncup_tpu.ops.corr import (
+    build_corr_pyramid,
+    corr_lookup,
+    corr_lookup_onthefly,
+)
+from raft_ncup_tpu.ops.geometry import (
+    convex_upsample,
+    coords_grid,
+    upflow,
+    upsample_nearest,
+)
+
+
+class RAFT:
+    """Model bundle + functional forward.
+
+    Usage::
+
+        model = RAFT(cfg)
+        variables = model.init(rng, (1, 368, 768, 3))
+        flows = model.apply(variables, img1, img2, iters=12, train=True)
+        flow_lr, flow_up = model.apply(variables, img1, img2, iters=32,
+                                       test_mode=True)
+
+    ``variables`` is ``{'params': {...}, 'batch_stats': {...}}``; images are
+    NHWC uint8-range float32 in [0, 255] (normalization happens inside, as
+    in reference: core/raft.py:90-91).
+    """
+
+    def __init__(self, cfg: ModelConfig):
+        self.cfg = cfg
+        dtype = jnp.bfloat16 if cfg.mixed_precision else None
+        hdim, cdim = cfg.hidden_dim, cfg.context_dim
+
+        if cfg.small:
+            self.fnet = Encoder(128, "instance", cfg.dropout, small=True, dtype=dtype)
+            self.cnet = Encoder(
+                hdim + cdim, "none", cfg.dropout, small=True, dtype=dtype
+            )
+            self.update_block = SmallUpdateBlock(
+                cfg.corr_planes, hdim, dtype=dtype
+            )
+        else:
+            self.fnet = Encoder(256, "instance", cfg.dropout, small=False, dtype=dtype)
+            self.cnet = Encoder(
+                hdim + cdim, "batch", cfg.dropout, small=False, dtype=dtype
+            )
+            self.update_block = BasicUpdateBlock(
+                cfg.corr_planes,
+                hdim,
+                # raft_nc_dbl deletes the convex mask head (reference:
+                # core/raft_nc_dbl.py:68).
+                use_mask_head=(cfg.variant == "raft"),
+                dtype=dtype,
+            )
+
+        self.upsampler = None
+        if cfg.variant == "raft_nc_dbl":
+            # NCUP consumes 2-channel flow with 128-channel GRU guidance
+            # (reference: core/raft_nc_dbl.py:75).
+            self.upsampler = build_upsampler(cfg.upsampler, cfg.dataset)
+
+    # ------------------------------------------------------------------ init
+
+    def init(self, rng: jax.Array, image_shape: tuple[int, ...]) -> dict:
+        """Initialize all components. ``image_shape`` is NHWC with H, W
+        divisible by 8."""
+        B, H, W, _ = image_shape
+        h8, w8 = H // 8, W // 8
+        cfg = self.cfg
+        hdim, cdim = cfg.hidden_dim, cfg.context_dim
+        kf, kc, ku, kup = jax.random.split(rng, 4)
+
+        img = jnp.zeros((B, H, W, 3), jnp.float32)
+        vf = self.fnet.init(kf, img)
+        vc = self.cnet.init(kc, img)
+
+        net = jnp.zeros((B, h8, w8, hdim), jnp.float32)
+        inp = jnp.zeros((B, h8, w8, cdim), jnp.float32)
+        corr = jnp.zeros((B, h8, w8, cfg.corr_planes), jnp.float32)
+        flow = jnp.zeros((B, h8, w8, 2), jnp.float32)
+        vu = self.update_block.init(ku, net, inp, corr, flow)
+
+        params = {
+            "fnet": vf["params"],
+            "cnet": vc["params"],
+            "update_block": vu["params"],
+        }
+        batch_stats = {}
+        for name, v in (("fnet", vf), ("cnet", vc), ("update_block", vu)):
+            if "batch_stats" in v:
+                batch_stats[name] = v["batch_stats"]
+
+        if self.upsampler is not None:
+            flow2 = jnp.zeros((B, h8 * 2, w8 * 2, 2), jnp.float32)
+            guidance = jnp.zeros((B, h8, w8, hdim), jnp.float32)
+            vup = self.upsampler.init(kup, flow2, guidance)
+            params["upsampler"] = vup["params"]
+            if "batch_stats" in vup:
+                batch_stats["upsampler"] = vup["batch_stats"]
+
+        out = {"params": params}
+        if batch_stats:
+            out["batch_stats"] = batch_stats
+        return out
+
+    # ----------------------------------------------------------------- apply
+
+    def apply(
+        self,
+        variables: dict,
+        image1: jax.Array,
+        image2: jax.Array,
+        iters: int = 12,
+        flow_init: Optional[jax.Array] = None,
+        test_mode: bool = False,
+        train: bool = False,
+        freeze_bn: bool = False,
+        rngs: Optional[dict] = None,
+        remat: bool = True,
+        mutable: bool = False,
+    ):
+        """Estimate optical flow between a pair of NHWC image batches.
+
+        Returns (train mode) the stacked per-iteration high-res flow
+        predictions (iters, B, H, W, 2); (test_mode) the tuple
+        ``(flow_lowres, flow_up)``. With ``mutable=True`` additionally
+        returns the updated batch_stats as a second element.
+        """
+        cfg = self.cfg
+        if image1.shape[1] % 8 or image1.shape[2] % 8:
+            raise ValueError(
+                f"image H, W must be divisible by 8, got {image1.shape[1:3]}; "
+                "pad inputs with raft_ncup_tpu.ops.InputPadder first"
+            )
+        params = variables["params"]
+        bstats = dict(variables.get("batch_stats", {}))
+        bn_train = train and not freeze_bn
+        hdim, cdim = cfg.hidden_dim, cfg.context_dim
+
+        img1 = 2.0 * (image1 / 255.0) - 1.0
+        img2 = 2.0 * (image2 / 255.0) - 1.0
+
+        def run(name, module, *args, **kwargs):
+            v = {"params": params[name]}
+            if name in bstats:
+                v["batch_stats"] = bstats[name]
+            if bn_train and name in bstats:
+                out, mut = module.apply(
+                    v, *args, mutable=["batch_stats"], rngs=rngs, **kwargs
+                )
+                bstats[name] = mut["batch_stats"]
+                return out
+            return module.apply(v, *args, rngs=rngs, **kwargs)
+
+        # Siamese feature extraction: both frames through fnet in one batch
+        # (reference: core/extractor.py:168-174).
+        fmaps = run(
+            "fnet",
+            self.fnet,
+            jnp.concatenate([img1, img2], axis=0),
+            train=train,
+            bn_train=bn_train,
+        )
+        fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
+        fmap1 = fmap1.astype(jnp.float32)
+        fmap2 = fmap2.astype(jnp.float32)
+
+        radius = cfg.resolved_corr_radius
+        if cfg.corr_impl == "volume":
+            pyramid = build_corr_pyramid(fmap1, fmap2, cfg.corr_levels)
+
+            def corr_fn(coords):
+                return corr_lookup(pyramid, coords, radius)
+
+        elif cfg.corr_impl == "onthefly":
+
+            def corr_fn(coords):
+                return corr_lookup_onthefly(
+                    fmap1, fmap2, coords, radius, cfg.corr_levels
+                )
+
+        elif cfg.corr_impl == "pallas":
+            try:
+                from raft_ncup_tpu.ops.corr_pallas import corr_lookup_pallas
+            except ImportError as e:
+                raise NotImplementedError(
+                    "corr_impl='pallas' requires raft_ncup_tpu.ops.corr_pallas"
+                ) from e
+
+            def corr_fn(coords):
+                return corr_lookup_pallas(
+                    fmap1, fmap2, coords, radius, cfg.corr_levels
+                )
+
+        else:
+            raise ValueError(f"unknown corr_impl: {cfg.corr_impl!r}")
+
+        cnet_out = run("cnet", self.cnet, img1, train=train, bn_train=bn_train)
+        net = jnp.tanh(cnet_out[..., :hdim])
+        inp = jax.nn.relu(cnet_out[..., hdim:])
+
+        B, H, W, _ = image1.shape
+        coords0 = coords_grid(B, H // 8, W // 8)
+        coords1 = coords_grid(B, H // 8, W // 8)
+        if flow_init is not None:
+            coords1 = coords1 + flow_init
+
+        def upsample_prediction(coords1, net, up_mask):
+            flow_lr = coords1 - coords0
+            if cfg.variant == "raft_nc_dbl":
+                # nearest x2, NCUP x4, values x8 (reference:
+                # core/raft_nc_dbl.py:107-112,161).
+                flow2 = upsample_nearest(flow_lr, 2)
+                guidance = net.astype(jnp.float32)
+                # The upsampler's only train-dependent piece is BatchNorm in
+                # the weights-estimation net, so it takes the bn flag.
+                hr = run(
+                    "upsampler", self.upsampler, flow2, guidance, train=bn_train
+                )
+                return 8.0 * hr
+            if up_mask is None:
+                return upflow(flow_lr, 8, align_corners=cfg.align_corners)
+            return convex_upsample(flow_lr, up_mask.astype(jnp.float32), 8)
+
+        # The raft (non-small) variant's convex upsampling needs the final
+        # iteration's mask; in test mode the mask rides the scan carry so
+        # upsampling runs once after the loop instead of every iteration.
+        has_mask = cfg.variant == "raft" and not cfg.small
+        carry_mask = has_mask and test_mode
+
+        def step(carry, _):
+            net, coords1, stats = carry
+            # Restore mutable stats captured in the carry so `run` sees the
+            # per-iteration BatchNorm state (upsampler only).
+            if "upsampler" in stats:
+                bstats["upsampler"] = stats["upsampler"]
+            coords1 = jax.lax.stop_gradient(coords1)  # .detach() per iter
+            corr = corr_fn(coords1)
+            flow = coords1 - coords0
+            net, up_mask, delta = run(
+                "update_block",
+                self.update_block,
+                net,
+                inp,
+                corr,
+                flow.astype(net.dtype),
+            )
+            coords1 = coords1 + delta.astype(jnp.float32)
+
+            if test_mode:
+                out = None
+            else:
+                out = upsample_prediction(coords1, net, up_mask)
+            new_stats = dict(stats)
+            if "upsampler" in stats:
+                new_stats["upsampler"] = bstats["upsampler"]
+            if carry_mask:
+                new_stats["up_mask"] = up_mask
+            return (net, coords1, new_stats), out
+
+        init_stats: dict = {}
+        if bn_train and "upsampler" in bstats:
+            init_stats["upsampler"] = bstats["upsampler"]
+        if carry_mask:
+            init_stats["up_mask"] = jnp.zeros(
+                (B, H // 8, W // 8, 9 * 64), net.dtype
+            )
+
+        body = step
+        if train and remat:
+            body = jax.checkpoint(step)
+
+        (net, coords1, final_stats), flow_seq = jax.lax.scan(
+            body, (net, coords1, init_stats), None, length=iters
+        )
+        if "upsampler" in final_stats:
+            bstats["upsampler"] = final_stats["upsampler"]
+
+        if test_mode:
+            flow_up = upsample_prediction(
+                coords1, net, final_stats.get("up_mask")
+            )
+            result = (coords1 - coords0, flow_up)
+        else:
+            result = flow_seq
+
+        if mutable:
+            return result, bstats
+        return result
+
+
+@functools.lru_cache(maxsize=8)
+def get_model(cfg: ModelConfig) -> RAFT:
+    """Model registry/factory keyed by (hashable, frozen) config."""
+    return RAFT(cfg)

@@ -1,0 +1,18 @@
+from raft_ncup_tpu.nn.layers import Conv2d, ConvTranspose2d, Norm  # noqa: F401
+from raft_ncup_tpu.nn.extractor import BasicEncoder, SmallEncoder  # noqa: F401
+from raft_ncup_tpu.nn.update import (  # noqa: F401
+    BasicMotionEncoder,
+    BasicUpdateBlock,
+    ConvGRU,
+    FlowHead,
+    SepConvGRU,
+    SmallMotionEncoder,
+    SmallUpdateBlock,
+)
+from raft_ncup_tpu.nn.nconv_unet import NConv2dLayer, NConvUNet  # noqa: F401
+from raft_ncup_tpu.nn.weights_est import SimpleWeightsNet, UNetWeightsNet  # noqa: F401
+from raft_ncup_tpu.nn.upsampler import (  # noqa: F401
+    BilinearUpsampler,
+    NConvUpsampler,
+    build_upsampler,
+)

@@ -1,0 +1,152 @@
+"""Feature/context encoders (reference: core/extractor.py).
+
+Stride-8 CNNs in NHWC: 7x7/s2 stem + three 2-block residual stages
+(64->96->128 for Basic at strides 1,2,2; bottleneck 32->64->96 for Small)
++ 1x1 output conv. Norm selectable per encoder: instance for fnet, batch
+(Basic) / none (Small) for cnet (reference: core/raft.py:45-53). Encoder
+convs use kaiming_normal(fan_out) init (reference: core/extractor.py:150-157).
+
+The siamese trick (two images concatenated along batch, reference:
+core/extractor.py:168-192) is applied by the caller — it halves the number
+of XLA conv dispatches and batches better on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+
+from raft_ncup_tpu.nn.layers import Conv2d, Norm
+
+
+class ResidualBlock(nn.Module):
+    """Two 3x3 convs + identity/downsample shortcut (reference:
+    core/extractor.py:6-56)."""
+
+    planes: int
+    norm_fn: str = "group"
+    stride: int = 1
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        ng = self.planes // 8
+
+        def conv(s: int, name: str) -> Conv2d:
+            return Conv2d(
+                self.planes, 3, stride=s, init_mode="kaiming_out",
+                dtype=self.dtype, name=name,
+            )
+
+        y = conv(self.stride, "conv1")(x)
+        y = Norm(self.norm_fn, num_groups=ng, name="norm1")(y, train=train)
+        y = nn.relu(y)
+        y = conv(1, "conv2")(y)
+        y = Norm(self.norm_fn, num_groups=ng, name="norm2")(y, train=train)
+        y = nn.relu(y)
+
+        if self.stride != 1:
+            x = Conv2d(
+                self.planes, 1, stride=self.stride, init_mode="kaiming_out",
+                dtype=self.dtype, name="downsample_conv",
+            )(x)
+            x = Norm(self.norm_fn, num_groups=ng, name="downsample_norm")(
+                x, train=train
+            )
+        return nn.relu(x + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck (reference: core/extractor.py:60-116)."""
+
+    planes: int
+    norm_fn: str = "group"
+    stride: int = 1
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        p4 = self.planes // 4
+        ng = self.planes // 8
+        y = Conv2d(p4, 1, init_mode="kaiming_out", dtype=self.dtype, name="conv1")(x)
+        y = Norm(self.norm_fn, num_groups=ng, name="norm1")(y, train=train)
+        y = nn.relu(y)
+        y = Conv2d(
+            p4, 3, stride=self.stride, init_mode="kaiming_out", dtype=self.dtype,
+            name="conv2",
+        )(y)
+        y = Norm(self.norm_fn, num_groups=ng, name="norm2")(y, train=train)
+        y = nn.relu(y)
+        y = Conv2d(
+            self.planes, 1, init_mode="kaiming_out", dtype=self.dtype, name="conv3"
+        )(y)
+        y = Norm(self.norm_fn, num_groups=ng, name="norm3")(y, train=train)
+        y = nn.relu(y)
+
+        if self.stride != 1:
+            x = Conv2d(
+                self.planes, 1, stride=self.stride, init_mode="kaiming_out",
+                dtype=self.dtype, name="downsample_conv",
+            )(x)
+            x = Norm(self.norm_fn, num_groups=ng, name="downsample_norm")(
+                x, train=train
+            )
+        return nn.relu(x + y)
+
+
+class Encoder(nn.Module):
+    """Stride-8 encoder; ``small`` selects the bottleneck variant."""
+
+    output_dim: int = 128
+    norm_fn: str = "batch"
+    dropout: float = 0.0
+    small: bool = False
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array, *, train: bool = False, bn_train: bool | None = None
+    ) -> jax.Array:
+        # `train` gates dropout; `bn_train` gates BatchNorm statistic
+        # updates (False = frozen BN, the reference's freeze_bn: train.py:185).
+        bn = train if bn_train is None else bn_train
+        stem = 32 if self.small else 64
+        stages = (32, 64, 96) if self.small else (64, 96, 128)
+        block = BottleneckBlock if self.small else ResidualBlock
+
+        x = Conv2d(
+            stem, 7, stride=2, init_mode="kaiming_out", dtype=self.dtype, name="conv1"
+        )(x)
+        # Stem GroupNorm uses 8 groups (reference: core/extractor.py:124,201).
+        x = Norm(self.norm_fn, num_groups=8, name="norm1")(x, train=bn)
+        x = nn.relu(x)
+
+        for i, (dim, stride) in enumerate(zip(stages, (1, 2, 2)), start=1):
+            x = block(dim, self.norm_fn, stride, dtype=self.dtype, name=f"layer{i}_0")(
+                x, train=bn
+            )
+            x = block(dim, self.norm_fn, 1, dtype=self.dtype, name=f"layer{i}_1")(
+                x, train=bn
+            )
+
+        x = Conv2d(
+            self.output_dim, 1, init_mode="kaiming_out", dtype=self.dtype, name="conv2"
+        )(x)
+        if self.dropout > 0:
+            # Dropout2d semantics: whole channels dropped per sample.
+            x = nn.Dropout(
+                rate=self.dropout, broadcast_dims=(1, 2), deterministic=not train
+            )(x)
+        return x
+
+
+def BasicEncoder(output_dim=128, norm_fn="batch", dropout=0.0, dtype=None, name=None):
+    """reference: core/extractor.py:118-192."""
+    return Encoder(output_dim, norm_fn, dropout, small=False, dtype=dtype, name=name)
+
+
+def SmallEncoder(output_dim=128, norm_fn="instance", dropout=0.0, dtype=None, name=None):
+    """reference: core/extractor.py:195-267."""
+    return Encoder(output_dim, norm_fn, dropout, small=True, dtype=dtype, name=name)

@@ -1,0 +1,195 @@
+"""Base layers: convolution and normalization with PyTorch-matching
+initialization and numerics.
+
+Initialization parity matters for training-dynamics parity with the
+reference, so ``Conv2d`` reproduces torch's defaults exactly:
+
+- kernel: kaiming_uniform(a=sqrt(5))  => U(-b, b), b = sqrt(1 / fan_in)
+- bias:   U(-1/sqrt(fan_in), 1/sqrt(fan_in))
+
+and the encoders' explicit ``kaiming_normal_(mode='fan_out')`` (reference:
+core/extractor.py:150-157) is available as ``init_mode='kaiming_out'``.
+
+Mixed precision: params live in float32; when ``dtype`` is bfloat16 the
+convolution computes in bfloat16 (the TPU analogue of the reference's CUDA
+autocast regions), while norms always compute in float32.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _pair(v) -> tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def _uniform_init(bound: float):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+    return init
+
+
+class Conv2d(nn.Module):
+    """NHWC convolution with torch-compatible padding and init.
+
+    Default padding is kernel//2 per axis — the scheme every conv in the
+    reference uses (explicit ``padding=k//2`` at each call site).
+    """
+
+    features: int
+    kernel_size: Any = 3
+    stride: Any = 1
+    dilation: Any = 1
+    padding: Optional[Any] = None
+    use_bias: bool = True
+    groups: int = 1
+    init_mode: str = "torch"  # 'torch' | 'kaiming_out'
+    dtype: Any = None  # compute dtype; None = input dtype
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        dh, dw = _pair(self.dilation)
+        cin = x.shape[-1]
+        fan_in = (cin // self.groups) * kh * kw
+
+        if self.init_mode == "torch":
+            kinit = _uniform_init(math.sqrt(1.0 / fan_in))
+        elif self.init_mode == "kaiming_out":
+            fan_out = (self.features // self.groups) * kh * kw
+            kinit = nn.initializers.normal(stddev=math.sqrt(2.0 / fan_out))
+        else:
+            raise ValueError(f"unknown init_mode: {self.init_mode!r}")
+
+        kernel = self.param(
+            "kernel", kinit, (kh, kw, cin // self.groups, self.features), jnp.float32
+        )
+
+        if self.padding is None:
+            ph, pw = kh // 2, kw // 2
+        else:
+            ph, pw = _pair(self.padding)
+        # torch pads k//2 for odd kernels; with dilation the reference
+        # computes pad = k//2 + (k-1)(d-1)/2 at call sites — callers pass
+        # that explicitly via `padding`.
+        pad = ((ph, ph), (pw, pw))
+
+        cdt = self.dtype or x.dtype
+        dn = jax.lax.conv_dimension_numbers(
+            x.shape, kernel.shape, ("NHWC", "HWIO", "NHWC")
+        )
+        y = jax.lax.conv_general_dilated(
+            x.astype(cdt),
+            kernel.astype(cdt),
+            window_strides=(sh, sw),
+            padding=pad,
+            rhs_dilation=(dh, dw),
+            dimension_numbers=dn,
+            feature_group_count=self.groups,
+        )
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                _uniform_init(1.0 / math.sqrt(fan_in)),
+                (self.features,),
+                jnp.float32,
+            )
+            y = y + bias.astype(cdt)
+        return y
+
+
+class ConvTranspose2d(nn.Module):
+    """NHWC transposed convolution matching ``nn.ConvTranspose2d`` (used by
+    the UNet weights-estimation net, reference: core/interp_weights_est.py:135).
+    """
+
+    features: int
+    kernel_size: Any = 2
+    stride: Any = 2
+    use_bias: bool = True
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        cin = x.shape[-1]
+        # torch ConvTranspose2d weight is (in, out, kh, kw); its default
+        # kaiming_uniform(a=sqrt(5)) reads fan_in from dim 1: out * kh * kw.
+        fan_in = self.features * kh * kw
+        # Stored (kh, kw, out, in) — torch's (in, out, kh, kw) under the
+        # same OIHW->HWIO transpose the importer applies to regular convs.
+        # transpose_kernel=True makes lax.conv_transpose the exact gradient
+        # of a forward conv, matching nn.ConvTranspose2d bit-for-bit.
+        kernel = self.param(
+            "kernel",
+            _uniform_init(math.sqrt(1.0 / fan_in)),
+            (kh, kw, self.features, cin),
+            jnp.float32,
+        )
+        cdt = self.dtype or x.dtype
+        y = jax.lax.conv_transpose(
+            x.astype(cdt),
+            kernel.astype(cdt),
+            strides=(sh, sw),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            transpose_kernel=True,
+        )
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                _uniform_init(1.0 / math.sqrt(fan_in)),
+                (self.features,),
+                jnp.float32,
+            )
+            y = y + bias.astype(cdt)
+        return y
+
+
+class Norm(nn.Module):
+    """Normalization factory matching the reference's norm_fn choices
+    (reference: core/extractor.py:16-38,123-133).
+
+    - 'group': GroupNorm(affine), eps 1e-5.
+    - 'batch': BatchNorm, momentum 0.1 (torch) == flax momentum 0.9,
+       eps 1e-5. Eval/frozen mode uses running stats.
+    - 'instance': per-channel, per-sample normalization without affine
+       (torch InstanceNorm2d default affine=False).
+    - 'none': identity.
+
+    Norm math always runs in float32 regardless of activation dtype.
+    """
+
+    kind: str
+    num_groups: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        in_dtype = x.dtype
+        x32 = x.astype(jnp.float32)
+        if self.kind == "none":
+            return x
+        if self.kind == "group":
+            y = nn.GroupNorm(num_groups=self.num_groups, epsilon=1e-5)(x32)
+        elif self.kind == "instance":
+            y = nn.GroupNorm(
+                num_groups=x.shape[-1], epsilon=1e-5, use_bias=False, use_scale=False
+            )(x32)
+        elif self.kind == "batch":
+            y = nn.BatchNorm(
+                use_running_average=not train, momentum=0.9, epsilon=1e-5
+            )(x32)
+        else:
+            raise ValueError(f"unknown norm kind: {self.kind!r}")
+        return y.astype(in_dtype)

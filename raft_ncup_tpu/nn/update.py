@@ -1,0 +1,172 @@
+"""Recurrent update blocks (reference: core/update.py).
+
+Motion encoder fuses correlation features and current flow; a conv GRU
+(separable 1x5/5x1 for the Basic variant) refines a hidden state; a flow
+head emits the per-iteration flow delta, and (for the RAFT baseline) a mask
+head emits the convex-upsampling weights scaled by 0.25 (reference:
+core/update.py:138-140).
+
+These run inside ``lax.scan`` over refinement iterations, so everything is
+shape-static. The GRU state is the scan carry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from raft_ncup_tpu.nn.layers import Conv2d
+
+
+class FlowHead(nn.Module):
+    """reference: core/update.py:6-14."""
+
+    hidden_dim: int = 256
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = Conv2d(self.hidden_dim, 3, dtype=self.dtype, name="conv1")(x)
+        x = nn.relu(x)
+        return Conv2d(2, 3, dtype=self.dtype, name="conv2")(x)
+
+
+class ConvGRU(nn.Module):
+    """Plain 3x3 conv GRU (reference: core/update.py:16-31)."""
+
+    hidden_dim: int = 128
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, h: jax.Array, x: jax.Array) -> jax.Array:
+        hx = jnp.concatenate([h, x], axis=-1)
+        z = nn.sigmoid(Conv2d(self.hidden_dim, 3, dtype=self.dtype, name="convz")(hx))
+        r = nn.sigmoid(Conv2d(self.hidden_dim, 3, dtype=self.dtype, name="convr")(hx))
+        q = nn.tanh(
+            Conv2d(self.hidden_dim, 3, dtype=self.dtype, name="convq")(
+                jnp.concatenate([r * h, x], axis=-1)
+            )
+        )
+        return (1 - z) * h + z * q
+
+
+class SepConvGRU(nn.Module):
+    """Separable GRU: a horizontal (1x5) pass then a vertical (5x1) pass
+    (reference: core/update.py:33-60)."""
+
+    hidden_dim: int = 128
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, h: jax.Array, x: jax.Array) -> jax.Array:
+        for suffix, ksize in (("1", (1, 5)), ("2", (5, 1))):
+            hx = jnp.concatenate([h, x], axis=-1)
+            z = nn.sigmoid(
+                Conv2d(self.hidden_dim, ksize, dtype=self.dtype, name=f"convz{suffix}")(hx)
+            )
+            r = nn.sigmoid(
+                Conv2d(self.hidden_dim, ksize, dtype=self.dtype, name=f"convr{suffix}")(hx)
+            )
+            q = nn.tanh(
+                Conv2d(self.hidden_dim, ksize, dtype=self.dtype, name=f"convq{suffix}")(
+                    jnp.concatenate([r * h, x], axis=-1)
+                )
+            )
+            h = (1 - z) * h + z * q
+        return h
+
+
+class SmallMotionEncoder(nn.Module):
+    """reference: core/update.py:62-77."""
+
+    corr_planes: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, flow: jax.Array, corr: jax.Array) -> jax.Array:
+        cor = nn.relu(Conv2d(96, 1, dtype=self.dtype, name="convc1")(corr))
+        flo = nn.relu(Conv2d(64, 7, dtype=self.dtype, name="convf1")(flow))
+        flo = nn.relu(Conv2d(32, 3, dtype=self.dtype, name="convf2")(flo))
+        out = nn.relu(
+            Conv2d(80, 3, dtype=self.dtype, name="conv")(
+                jnp.concatenate([cor, flo], axis=-1)
+            )
+        )
+        return jnp.concatenate([out, flow], axis=-1)
+
+
+class BasicMotionEncoder(nn.Module):
+    """reference: core/update.py:79-97."""
+
+    corr_planes: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, flow: jax.Array, corr: jax.Array) -> jax.Array:
+        cor = nn.relu(Conv2d(256, 1, dtype=self.dtype, name="convc1")(corr))
+        cor = nn.relu(Conv2d(192, 3, dtype=self.dtype, name="convc2")(cor))
+        flo = nn.relu(Conv2d(128, 7, dtype=self.dtype, name="convf1")(flow))
+        flo = nn.relu(Conv2d(64, 3, dtype=self.dtype, name="convf2")(flo))
+        out = nn.relu(
+            Conv2d(128 - 2, 3, dtype=self.dtype, name="conv")(
+                jnp.concatenate([cor, flo], axis=-1)
+            )
+        )
+        return jnp.concatenate([out, flow], axis=-1)
+
+
+class SmallUpdateBlock(nn.Module):
+    """reference: core/update.py:99-112. No mask head: the small path
+    upsamples bilinearly."""
+
+    corr_planes: int
+    hidden_dim: int = 96
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(
+        self, net: jax.Array, inp: jax.Array, corr: jax.Array, flow: jax.Array
+    ) -> tuple[jax.Array, Optional[jax.Array], jax.Array]:
+        motion = SmallMotionEncoder(self.corr_planes, dtype=self.dtype, name="encoder")(
+            flow, corr
+        )
+        x = jnp.concatenate([inp, motion], axis=-1)
+        net = ConvGRU(self.hidden_dim, dtype=self.dtype, name="gru")(net, x)
+        delta = FlowHead(128, dtype=self.dtype, name="flow_head")(net)
+        return net, None, delta
+
+
+class BasicUpdateBlock(nn.Module):
+    """reference: core/update.py:114-141.
+
+    ``use_mask_head=False`` reproduces raft_nc_dbl's deletion of the convex
+    mask head (reference: core/raft_nc_dbl.py:68) — the NCUP upsampler
+    consumes the GRU hidden state as guidance instead.
+    """
+
+    corr_planes: int
+    hidden_dim: int = 128
+    use_mask_head: bool = True
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(
+        self, net: jax.Array, inp: jax.Array, corr: jax.Array, flow: jax.Array
+    ) -> tuple[jax.Array, Optional[jax.Array], jax.Array]:
+        motion = BasicMotionEncoder(self.corr_planes, dtype=self.dtype, name="encoder")(
+            flow, corr
+        )
+        x = jnp.concatenate([inp, motion], axis=-1)
+        net = SepConvGRU(self.hidden_dim, dtype=self.dtype, name="gru")(net, x)
+        delta = FlowHead(256, dtype=self.dtype, name="flow_head")(net)
+
+        mask = None
+        if self.use_mask_head:
+            m = nn.relu(Conv2d(256, 3, dtype=self.dtype, name="mask_conv1")(net))
+            m = Conv2d(64 * 9, 1, dtype=self.dtype, name="mask_conv2")(m)
+            # 0.25 scale to balance gradients (reference: core/update.py:140).
+            mask = 0.25 * m
+        return net, mask, delta

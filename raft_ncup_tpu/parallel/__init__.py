@@ -1,0 +1,5 @@
+from raft_ncup_tpu.parallel.mesh import make_mesh  # noqa: F401
+from raft_ncup_tpu.parallel.step import (  # noqa: F401
+    make_eval_step,
+    make_train_step,
+)

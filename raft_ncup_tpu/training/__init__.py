@@ -1,0 +1,3 @@
+from raft_ncup_tpu.training.loss import sequence_loss  # noqa: F401
+from raft_ncup_tpu.training.optim import build_optimizer, onecycle_linear  # noqa: F401
+from raft_ncup_tpu.training.state import TrainState, create_train_state  # noqa: F401

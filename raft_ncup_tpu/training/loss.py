@@ -1,0 +1,58 @@
+"""Sequence loss + training metrics (reference: train.py:42-71).
+
+Gamma-weighted L1 over the per-iteration flow predictions. Faithfulness
+notes:
+
+- the per-iteration term is ``mean(valid * |pred - gt|)`` over *all*
+  elements — invalid pixels contribute zeros to the numerator but still
+  count in the denominator, exactly as the reference's
+  ``(valid[:, None] * i_loss).mean()``;
+- validity = (valid >= 0.5) AND (|flow_gt| < max_flow), max_flow 400;
+- metrics (epe / 1px / 3px / 5px) are computed on the *final* prediction
+  over valid pixels only.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sequence_loss(
+    flow_preds: jax.Array,
+    flow_gt: jax.Array,
+    valid: jax.Array,
+    gamma: float = 0.8,
+    max_flow: float = 400.0,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Args:
+      flow_preds: (T, B, H, W, 2) per-iteration predictions.
+      flow_gt: (B, H, W, 2).
+      valid: (B, H, W) float or bool.
+    Returns:
+      (scalar loss, metrics dict).
+    """
+    n = flow_preds.shape[0]
+    mag = jnp.sqrt(jnp.sum(flow_gt**2, axis=-1))
+    valid = (valid >= 0.5) & (mag < max_flow)
+    vmask = valid[None, ..., None].astype(flow_preds.dtype)  # (1, B, H, W, 1)
+
+    weights = gamma ** jnp.arange(n - 1, -1, -1, dtype=flow_preds.dtype)
+    abs_err = jnp.abs(flow_preds - flow_gt[None])
+    per_iter = jnp.mean(vmask * abs_err, axis=(1, 2, 3, 4))  # (T,)
+    loss = jnp.sum(weights * per_iter)
+
+    epe = jnp.sqrt(jnp.sum((flow_preds[-1] - flow_gt) ** 2, axis=-1))
+    v = valid.astype(epe.dtype)
+    denom = jnp.maximum(v.sum(), 1.0)
+
+    def vmean(x):
+        return (x * v).sum() / denom
+
+    metrics = {
+        "epe": vmean(epe),
+        "1px": vmean((epe < 1).astype(epe.dtype)),
+        "3px": vmean((epe < 3).astype(epe.dtype)),
+        "5px": vmean((epe < 5).astype(epe.dtype)),
+    }
+    return loss, metrics

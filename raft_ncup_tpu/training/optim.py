@@ -1,0 +1,121 @@
+"""Optimizers and LR schedules (reference: train.py:83-99).
+
+AdamW/Adam with global-norm gradient clipping (clip 1.0, reference:
+train.py:221) and either the OneCycle-linear schedule or StepLR. optax has
+no exact torch OneCycleLR, so the ``anneal_strategy='linear'`` schedule is
+implemented directly: warmup from max_lr/div_factor to max_lr over
+pct_start of total steps, then linear anneal to
+max_lr/div_factor/final_div_factor — over ``num_steps + 100`` total steps
+with pct_start 0.05 as the reference configures it.
+
+``freeze_raft`` (reference: core/raft_nc_dbl.py:70-72) is realized with an
+optax mask that zeroes updates for every trunk parameter, training only
+the upsampler.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from raft_ncup_tpu.config import TrainConfig
+
+
+def onecycle_linear(
+    max_lr: float,
+    total_steps: int,
+    pct_start: float = 0.05,
+    div_factor: float = 25.0,
+    final_div_factor: float = 1e4,
+) -> Callable[[jax.Array], jax.Array]:
+    """torch OneCycleLR(anneal_strategy='linear', cycle_momentum=False).
+
+    Phase boundaries match torch's ``_schedule_phases``: warmup ends at
+    ``pct_start * total_steps - 1``; anneal ends at ``total_steps - 1``.
+    """
+    initial = max_lr / div_factor
+    final = initial / final_div_factor
+    warm_end = float(pct_start * total_steps) - 1.0
+    ann_end = float(total_steps - 1)
+
+    def schedule(count):
+        step = jnp.asarray(count, jnp.float32)
+        warm_pct = jnp.clip(step / jnp.maximum(warm_end, 1e-8), 0.0, 1.0)
+        up = initial + warm_pct * (max_lr - initial)
+        ann_pct = jnp.clip(
+            (step - warm_end) / jnp.maximum(ann_end - warm_end, 1e-8), 0.0, 1.0
+        )
+        down = max_lr + ann_pct * (final - max_lr)
+        return jnp.where(step <= warm_end, up, down)
+
+    return schedule
+
+
+def step_lr(base_lr: float, step_size: int, gamma: float = 0.5):
+    """torch StepLR (reference: train.py:95-96)."""
+
+    def schedule(count):
+        return base_lr * gamma ** (jnp.asarray(count) // step_size)
+
+    return schedule
+
+
+def build_schedule(cfg: TrainConfig):
+    if cfg.scheduler.lower() == "cyclic":
+        return onecycle_linear(cfg.lr, cfg.total_schedule_steps, pct_start=0.05)
+    if cfg.scheduler.lower() == "step":
+        return step_lr(cfg.lr, cfg.scheduler_step, 0.5)
+    raise NotImplementedError(f"{cfg.scheduler} scheduler is not implemented!")
+
+
+def build_optimizer(
+    cfg: TrainConfig,
+    trainable_mask: Optional[dict] = None,
+) -> optax.GradientTransformation:
+    """clip-by-global-norm -> Adam(W) with the configured schedule.
+
+    Args:
+      trainable_mask: params-shaped pytree of bools; False freezes the
+        parameter (used for freeze_raft).
+    """
+    schedule = build_schedule(cfg)
+    if cfg.optimizer.lower() == "adamw":
+        opt = optax.adamw(
+            learning_rate=schedule,
+            b1=0.9,
+            b2=0.999,
+            eps=cfg.epsilon,
+            weight_decay=cfg.wdecay,
+        )
+    elif cfg.optimizer.lower() == "adam":
+        opt = optax.adam(
+            learning_rate=schedule, b1=0.9, b2=0.999, eps=cfg.epsilon
+        )
+    else:
+        raise NotImplementedError(f"{cfg.optimizer} optimizer is not implemented!")
+
+    tx = optax.chain(optax.clip_by_global_norm(cfg.clip), opt)
+    if trainable_mask is not None:
+        # multi_transform so the gradient-norm clip sees only trainable
+        # parameters — matching torch, where frozen params have no grads at
+        # all and so don't contribute to the clipped norm.
+        labels = jax.tree.map(
+            lambda m: "train" if m else "frozen", trainable_mask
+        )
+        tx = optax.multi_transform(
+            {"train": tx, "frozen": optax.set_to_zero()}, labels
+        )
+    return tx
+
+
+def freeze_raft_mask(params: dict) -> dict:
+    """Trainable-mask marking only the upsampler as trainable (reference:
+    core/raft_nc_dbl.py:70-75: the trunk is frozen *before* the upsampler
+    is attached, so only upsampler params receive gradients)."""
+    return {
+        top: jax.tree.map(lambda _: top == "upsampler", sub)
+        for top, sub in params.items()
+    }

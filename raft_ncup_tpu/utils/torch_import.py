@@ -1,0 +1,187 @@
+"""Import PyTorch reference checkpoints into our parameter trees.
+
+Supports the reference's three checkpoint-loading semantics (SURVEY.md §5):
+
+- ``--restore_ckpt`` on a DataParallel-wrapped model (keys prefixed
+  ``module.``, non-strict in train / strict in eval — reference:
+  train.py:179-180, evaluate.py:257);
+- ``--load_pretrained`` warm-starting the RAFT trunk before NCUP is
+  attached (prefix-stripping load — reference: core/raft_nc_dbl.py:57-66);
+- plain state dicts.
+
+Layout translation: torch convs are OIHW, ours are HWIO; torch norm
+``weight``/``bias``/``running_mean``/``running_var`` become flax
+``scale``/``bias`` params and ``mean``/``var`` batch_stats. Module-path
+translation is table-driven and validated against the destination tree, so
+unknown/missing keys are reported instead of silently dropped.
+
+This module deliberately has no torch dependency: checkpoints are loaded
+with ``torch.load`` by the caller (or any pickle reader) and passed in as a
+``{key: numpy array}`` mapping.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Mapping
+
+import numpy as np
+from flax import traverse_util
+
+_SEGMENT_RULES: list[tuple[re.Pattern, Any]] = [
+    (re.compile(r"^layer(\d+)\.(\d+)$"), lambda m: [f"layer{m.group(1)}_{m.group(2)}"]),
+    (re.compile(r"^downsample\.0$"), lambda m: ["downsample_conv"]),
+    (re.compile(r"^downsample\.1$"), lambda m: ["downsample_norm"]),
+    (re.compile(r"^mask\.0$"), lambda m: ["mask_conv1"]),
+    (re.compile(r"^mask\.2$"), lambda m: ["mask_conv2"]),
+    (re.compile(r"^nconv_x2\.(\d+)$"), lambda m: [f"nconv_x2_{m.group(1)}"]),
+    (re.compile(r"^decoder\.(\d+)$"), lambda m: [f"decoder_{m.group(1)}"]),
+    (re.compile(r"^encoder\.(\d+)$"), lambda m: [f"encoder_{m.group(1)}"]),
+    (re.compile(r"^conv\.(\d+)\.0$"), lambda m: [f"conv{m.group(1)}"]),
+    (re.compile(r"^conv\.(\d+)\.1$"), lambda m: [f"bn{m.group(1)}"]),
+]
+
+
+def _translate_module_path(parts: list[str]) -> list[str]:
+    """Translate a dotted torch module path into flax path segments."""
+    joined = ".".join(parts)
+    out: list[str] = []
+    i = 0
+    while i < len(parts):
+        matched = False
+        # Try two-segment and three-segment composite rules first.
+        for span in (3, 2, 1):
+            if i + span > len(parts):
+                continue
+            seg = ".".join(parts[i : i + span])
+            for pat, repl in _SEGMENT_RULES:
+                m = pat.match(seg)
+                if m:
+                    out.extend(repl(m))
+                    i += span
+                    matched = True
+                    break
+            if matched:
+                break
+        if not matched:
+            out.append(parts[i])
+            i += 1
+    del joined
+    return out
+
+
+def strip_module_prefix(state: Mapping[str, Any]) -> dict[str, Any]:
+    """Remove DataParallel's ``module.`` prefix (reference:
+    core/raft_nc_dbl.py:62-64)."""
+    return {
+        (k[len("module.") :] if k.startswith("module.") else k): v
+        for k, v in state.items()
+    }
+
+
+def import_torch_state(
+    state: Mapping[str, Any],
+    variables: dict,
+    strict: bool = True,
+) -> dict:
+    """Merge a torch state dict into ``variables`` (from ``RAFT.init``).
+
+    Args:
+      state: torch parameter name -> array-like (numpy or torch tensors).
+      variables: destination {'params': ..., 'batch_stats': ...} tree.
+      strict: raise if a checkpoint key has no destination (missing
+        destinations — e.g. loading a plain RAFT trunk into raft_nc_dbl —
+        are always allowed, mirroring the reference's strict=False resume).
+    Returns:
+      A new variables dict with imported values (float32 numpy).
+    """
+    state = strip_module_prefix(state)
+    params = dict(traverse_util.flatten_dict(variables.get("params", {})))
+    stats = dict(traverse_util.flatten_dict(variables.get("batch_stats", {})))
+
+    unmatched: list[str] = []
+    for tkey, tval in state.items():
+        leaf = tkey.rsplit(".", 1)[-1]
+        if leaf == "num_batches_tracked":
+            continue
+        val = np.asarray(getattr(tval, "numpy", lambda: tval)(), dtype=np.float32)
+        mod_parts = tkey.split(".")[:-1]
+        base = tuple(_translate_module_path(mod_parts))
+
+        placed = False
+        if leaf in ("weight", "weight_p"):
+            name = "kernel" if leaf == "weight" else "weight_p"
+            key = base + (name,)
+            if key in params:
+                if val.ndim == 4:
+                    val = val.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+                if params[key].shape != val.shape and val.ndim == 4:
+                    # ConvTranspose torch weight is (in, out, kh, kw); ours
+                    # is (kh, kw, out, in) — same transpose, so a mismatch
+                    # here is a real error.
+                    raise ValueError(
+                        f"shape mismatch for {tkey}: {val.shape} vs "
+                        f"{params[key].shape}"
+                    )
+                params[key] = val
+                placed = True
+            else:
+                # Norm weight -> scale on the wrapped norm module.
+                for inner in ("BatchNorm_0", "GroupNorm_0"):
+                    key = base + (inner, "scale")
+                    if key in params:
+                        params[key] = val
+                        placed = True
+                        break
+        elif leaf == "bias":
+            key = base + ("bias",)
+            if key in params:
+                params[key] = val
+                placed = True
+            else:
+                for inner in ("BatchNorm_0", "GroupNorm_0"):
+                    key = base + (inner, "bias")
+                    if key in params:
+                        params[key] = val
+                        placed = True
+                        break
+        elif leaf in ("running_mean", "running_var"):
+            name = "mean" if leaf == "running_mean" else "var"
+            key = base + ("BatchNorm_0", name)
+            if key in stats:
+                stats[key] = val
+                placed = True
+
+        if not placed:
+            # Shared-encoder aliases (interpolation_net.encoder.*) duplicate
+            # nconv_in / nconv_x2 tensors; silently skip those.
+            if ".encoder." in tkey and "interpolation_net" in tkey:
+                continue
+            # Residual/bottleneck blocks register the downsample norm both
+            # as normN and inside the downsample Sequential (reference:
+            # core/extractor.py:44-45,103-104); downsample.1 carries it.
+            if base and re.fullmatch(r"norm[34]", base[-1]):
+                alias = base[:-1] + ("downsample_norm",)
+                if any(k[: len(alias)] == alias for k in (*params, *stats)):
+                    continue
+            unmatched.append(tkey)
+
+    if unmatched and strict:
+        raise KeyError(
+            f"{len(unmatched)} torch keys had no destination, e.g. "
+            f"{unmatched[:5]}"
+        )
+
+    out = {"params": traverse_util.unflatten_dict(params)}
+    if stats:
+        out["batch_stats"] = traverse_util.unflatten_dict(stats)
+    return out
+
+
+def load_torch_checkpoint(path: str, variables: dict, strict: bool = True) -> dict:
+    """Load a ``.pth`` file (requires torch, CPU) and import it."""
+    import torch
+
+    state = torch.load(path, map_location="cpu", weights_only=True)
+    state = {k: v.numpy() for k, v in state.items()}
+    return import_torch_state(state, variables, strict=strict)

@@ -1,0 +1,203 @@
+"""Loss/schedule parity with torch and end-to-end train-step tests,
+including the sharded (data x spatial) step on 8 virtual devices."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from raft_ncup_tpu.config import ModelConfig, TrainConfig, small_model_config
+from raft_ncup_tpu.training.loss import sequence_loss
+from raft_ncup_tpu.training.optim import (
+    build_optimizer,
+    freeze_raft_mask,
+    onecycle_linear,
+)
+from raft_ncup_tpu.training.state import create_train_state
+from raft_ncup_tpu.parallel import make_mesh, make_train_step
+
+
+def torch_sequence_loss(flow_preds, flow_gt, valid, gamma=0.8, max_flow=400):
+    """Oracle mirroring reference train.py:46-71."""
+    n_predictions = len(flow_preds)
+    flow_loss = 0.0
+    mag = torch.sum(flow_gt**2, dim=1).sqrt()
+    valid = (valid >= 0.5) & (mag < max_flow)
+    for i in range(n_predictions):
+        i_weight = gamma ** (n_predictions - i - 1)
+        i_loss = (flow_preds[i] - flow_gt).abs()
+        flow_loss += i_weight * (valid[:, None] * i_loss).mean()
+    epe = torch.sum((flow_preds[-1] - flow_gt) ** 2, dim=1).sqrt()
+    epe = epe.view(-1)[valid.view(-1)]
+    metrics = {
+        "epe": epe.mean().item(),
+        "1px": (epe < 1).float().mean().item(),
+        "3px": (epe < 3).float().mean().item(),
+        "5px": (epe < 5).float().mean().item(),
+    }
+    return flow_loss.item(), metrics
+
+
+def test_sequence_loss_matches_torch():
+    rng = np.random.default_rng(0)
+    T, B, H, W = 4, 2, 16, 20
+    preds = rng.standard_normal((T, B, H, W, 2)).astype(np.float32) * 5
+    gt = rng.standard_normal((B, H, W, 2)).astype(np.float32) * 5
+    # Mix of valid/invalid plus one huge-flow pixel to exercise max_flow.
+    valid = (rng.uniform(size=(B, H, W)) > 0.3).astype(np.float32)
+    gt[0, 0, 0] = [500.0, 0.0]
+
+    loss, metrics = sequence_loss(
+        jnp.asarray(preds), jnp.asarray(gt), jnp.asarray(valid), gamma=0.8
+    )
+
+    tpreds = [torch.from_numpy(preds[t]).permute(0, 3, 1, 2) for t in range(T)]
+    tl, tm = torch_sequence_loss(
+        tpreds,
+        torch.from_numpy(gt).permute(0, 3, 1, 2),
+        torch.from_numpy(valid),
+    )
+    np.testing.assert_allclose(float(loss), tl, rtol=1e-5)
+    for k in ("epe", "1px", "3px", "5px"):
+        np.testing.assert_allclose(float(metrics[k]), tm[k], rtol=1e-4)
+
+
+def test_onecycle_matches_torch():
+    max_lr, total = 1.25e-4, 1100
+    sched = onecycle_linear(max_lr, total, pct_start=0.05)
+
+    dummy = torch.nn.Parameter(torch.zeros(1))
+    opt = torch.optim.AdamW([dummy], lr=max_lr)
+    tsched = torch.optim.lr_scheduler.OneCycleLR(
+        opt, max_lr, total, pct_start=0.05, cycle_momentum=False,
+        anneal_strategy="linear",
+    )
+    torch_lrs = []
+    for _ in range(total):
+        torch_lrs.append(tsched.get_last_lr()[0])
+        opt.step()
+        tsched.step()
+    ours = np.asarray(jax.vmap(sched)(jnp.arange(total)))
+    # atol covers fp32 cancellation at the ~5e-10 final LR.
+    np.testing.assert_allclose(ours, np.asarray(torch_lrs), rtol=1e-4, atol=1e-9)
+
+
+def test_adamw_update_matches_torch():
+    """One AdamW step with grad clipping vs torch on the same tensors."""
+    rng = np.random.default_rng(0)
+    p = rng.standard_normal((4, 3)).astype(np.float32)
+    g = (rng.standard_normal((4, 3)) * 10).astype(np.float32)  # big: clips
+
+    cfg = TrainConfig(lr=1e-3, wdecay=1e-4, epsilon=1e-8, clip=1.0,
+                      scheduler="step", scheduler_step=10**9)
+    tx = build_optimizer(cfg)
+    params = {"w": jnp.asarray(p)}
+    opt_state = tx.init(params)
+    updates, _ = tx.update({"w": jnp.asarray(g)}, opt_state, params)
+    new_p = np.asarray(params["w"] + updates["w"])
+
+    tp = torch.nn.Parameter(torch.from_numpy(p.copy()))
+    topt = torch.optim.AdamW([tp], lr=1e-3, weight_decay=1e-4, eps=1e-8)
+    tp.grad = torch.from_numpy(g.copy())
+    torch.nn.utils.clip_grad_norm_([tp], 1.0)
+    topt.step()
+    np.testing.assert_allclose(new_p, tp.detach().numpy(), atol=1e-6)
+
+
+def test_freeze_raft_mask_zeroes_trunk_updates():
+    cfg = small_model_config(variant="raft")
+    params = {"fnet": {"a": jnp.ones(3)}, "upsampler": {"b": jnp.ones(3)}}
+    mask = freeze_raft_mask(params)
+    assert mask["fnet"]["a"] is False and mask["upsampler"]["b"] is True
+
+    tcfg = TrainConfig(lr=1e-3, scheduler="step")
+    tx = build_optimizer(tcfg, trainable_mask=mask)
+    st = tx.init(params)
+    g = jax.tree.map(jnp.ones_like, params)
+    upd, _ = tx.update(g, st, params)
+    assert float(jnp.abs(upd["fnet"]["a"]).sum()) == 0.0
+    assert float(jnp.abs(upd["upsampler"]["b"]).sum()) > 0.0
+
+
+def _synthetic_batch(rng, B, H, W):
+    return {
+        "image1": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)), jnp.float32),
+        "image2": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)), jnp.float32),
+        "flow": jnp.asarray(rng.standard_normal((B, H, W, 2)), jnp.float32),
+        "valid": jnp.ones((B, H, W), jnp.float32),
+    }
+
+
+@pytest.mark.slow
+def test_train_step_single_device_decreases_loss():
+    """Overfit one tiny batch for a few steps: loss must drop."""
+    mcfg = small_model_config(variant="raft")
+    tcfg = TrainConfig(
+        stage="chairs", lr=1e-4, num_steps=50, batch_size=1,
+        image_size=(64, 96), iters=4,
+    )
+    model, state = create_train_state(jax.random.key(0), mcfg, tcfg)
+    step = make_train_step(model, tcfg)
+    batch = _synthetic_batch(np.random.default_rng(0), 1, 64, 96)
+
+    losses = []
+    rng = jax.random.key(1)
+    for i in range(8):
+        rng, k = jax.random.split(rng)
+        state, metrics = step(state, batch, k)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+@pytest.mark.slow
+def test_train_step_frozen_bn_non_chairs_stage():
+    """Regression: the big model (BatchNorm in cnet) must train on
+    non-chairs stages, where BN is frozen (reference: train.py:185-186)."""
+    mcfg = ModelConfig(variant="raft")
+    tcfg = TrainConfig(
+        stage="things", lr=1e-4, num_steps=50, batch_size=1,
+        image_size=(64, 64), iters=2,
+    )
+    model, state = create_train_state(jax.random.key(0), mcfg, tcfg)
+    step = make_train_step(model, tcfg)
+    batch = _synthetic_batch(np.random.default_rng(0), 1, 64, 64)
+    # Copy out before stepping: the jitted step donates the state buffers.
+    stats_before = [np.asarray(x) for x in jax.tree.leaves(state.batch_stats)]
+    state, metrics = step(state, batch, jax.random.key(1))
+    assert np.isfinite(float(metrics["loss"]))
+    # Frozen BN: running stats unchanged.
+    for a, b in zip(stats_before, jax.tree.leaves(state.batch_stats)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+
+@pytest.mark.slow
+def test_train_step_sharded_matches_single():
+    """The (data=4, spatial=2) sharded step must agree with the unsharded
+    step — XLA collectives shouldn't change the math."""
+    mcfg = small_model_config(variant="raft")
+    tcfg = TrainConfig(
+        stage="chairs", lr=1e-4, num_steps=50, batch_size=4,
+        image_size=(64, 64), iters=2,
+    )
+    model, state0 = create_train_state(jax.random.key(0), mcfg, tcfg)
+    batch = _synthetic_batch(np.random.default_rng(1), 4, 64, 64)
+    rngk = jax.random.key(2)
+
+    step_single = make_train_step(model, tcfg)
+    s1, m1 = step_single(state0, batch, rngk)
+
+    mesh = make_mesh(data=4, spatial=2)
+    model2, state2 = create_train_state(jax.random.key(0), mcfg, tcfg)
+    step_sharded = make_train_step(model2, tcfg, mesh=mesh)
+    s2, m2 = step_sharded(state2, batch, rngk)
+
+    np.testing.assert_allclose(
+        float(m1["loss"]), float(m2["loss"]), rtol=1e-4
+    )
+    # Updated parameters agree across the two execution strategies.
+    l1 = jax.tree.leaves(s1.params)
+    l2 = jax.tree.leaves(s2.params)
+    for a, b in zip(l1, l2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
