@@ -1,0 +1,87 @@
+"""Benchmark: flagship-model inference throughput on the available chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric: frame-pairs/sec/chip for raft_nc_dbl (NCUP) test-mode inference at
+12 GRU iterations, 368x768 (the Sintel fine-tune crop,
+reference: train_raft_nc_sintel.sh:14). The reference records no
+throughput anywhere (BASELINE.md), so ``vs_baseline`` is measured against
+a fixed reference-implementation proxy: the PyTorch reference on the same
+host achieves no recorded number — we report vs_baseline as the ratio to
+BASELINE_PAIRS_PER_SEC below once a round has recorded one (0.0 = no
+recorded baseline yet).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_ncup_tpu.config import flagship_config
+from raft_ncup_tpu.models.raft import get_model
+
+# First recorded value (round 1, single TPU chip, 2026-07-29) is the fixed
+# baseline all later rounds are measured against.
+BASELINE_PAIRS_PER_SEC = 1.3
+
+BATCH = 2
+HEIGHT, WIDTH = 368, 768
+ITERS = 12
+WARMUP = 2
+REPS = 5
+
+
+def main() -> None:
+    platform = jax.devices()[0].platform
+    cfg = flagship_config(dataset="sintel", mixed_precision=(platform == "tpu"))
+    model = get_model(cfg)
+    shape = (BATCH, HEIGHT, WIDTH, 3)
+    variables = model.init(jax.random.PRNGKey(0), shape)
+
+    @jax.jit
+    def forward(variables, image1, image2):
+        return model.apply(
+            variables, image1, image2, iters=ITERS, test_mode=True
+        )
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    img1 = jax.random.uniform(k1, shape, jnp.float32, 0.0, 255.0)
+    img2 = jax.random.uniform(k2, shape, jnp.float32, 0.0, 255.0)
+
+    def run_sync():
+        # On the axon TPU tunnel ``block_until_ready`` returns before the
+        # computation finishes; pulling a scalar to host is the only honest
+        # synchronization point.
+        _, flow_up = forward(variables, img1, img2)
+        return np.asarray(flow_up[0, 0, 0, 0])
+
+    for _ in range(WARMUP):
+        run_sync()
+
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        run_sync()
+    dt = time.perf_counter() - t0
+
+    pairs_per_sec = BATCH * REPS / dt
+    vs = pairs_per_sec / BASELINE_PAIRS_PER_SEC if BASELINE_PAIRS_PER_SEC else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": f"raft_nc_dbl frame-pairs/sec/chip @ {ITERS} iters "
+                f"{HEIGHT}x{WIDTH} ({platform})",
+                "value": round(pairs_per_sec, 3),
+                "unit": "pairs/s",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

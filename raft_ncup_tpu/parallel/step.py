@@ -14,7 +14,6 @@ reference's DataParallel per-replica stats.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax

@@ -44,7 +44,6 @@ _SEGMENT_RULES: list[tuple[re.Pattern, Any]] = [
 
 def _translate_module_path(parts: list[str]) -> list[str]:
     """Translate a dotted torch module path into flax path segments."""
-    joined = ".".join(parts)
     out: list[str] = []
     i = 0
     while i < len(parts):
@@ -66,7 +65,6 @@ def _translate_module_path(parts: list[str]) -> list[str]:
         if not matched:
             out.append(parts[i])
             i += 1
-    del joined
     return out
 
 
