@@ -6,11 +6,10 @@ Prints ONE JSON line:
 Metric: frame-pairs/sec/chip for raft_nc_dbl (NCUP) test-mode inference at
 12 GRU iterations, 368x768 (the Sintel fine-tune crop,
 reference: train_raft_nc_sintel.sh:14). The reference records no
-throughput anywhere (BASELINE.md), so ``vs_baseline`` is measured against
-a fixed reference-implementation proxy: the PyTorch reference on the same
-host achieves no recorded number — we report vs_baseline as the ratio to
-BASELINE_PAIRS_PER_SEC below once a round has recorded one (0.0 = no
-recorded baseline yet).
+throughput anywhere (BASELINE.md), so ``vs_baseline`` is the ratio to
+BASELINE_PAIRS_PER_SEC below — this framework's own first recorded
+round-1 number on a single TPU chip, fixed so later rounds show relative
+progress. It is NOT a PyTorch-reference comparison.
 """
 
 from __future__ import annotations

@@ -1,0 +1,21 @@
+from raft_ncup_tpu.io.flow_io import (
+    read_flo,
+    read_flow_kitti,
+    read_gen,
+    read_image,
+    read_pfm,
+    write_flo,
+    write_flow_kitti,
+    write_pfm,
+)
+
+__all__ = [
+    "read_flo",
+    "write_flo",
+    "read_pfm",
+    "write_pfm",
+    "read_flow_kitti",
+    "write_flow_kitti",
+    "read_image",
+    "read_gen",
+]

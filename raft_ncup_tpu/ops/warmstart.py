@@ -1,0 +1,46 @@
+"""Forward flow interpolation for warm-starting the next frame.
+
+Splats each pixel's flow to where it lands in the next frame, then fills
+the full grid by nearest-neighbor interpolation — the reference's
+scipy-``griddata`` warm start used by video-sequence evaluation
+(reference: core/utils/utils.py:28-56, used at evaluate.py:38-42).
+
+Host-side numpy: this runs once per frame between device steps, on the
+(H/8, W/8, 2) low-res flow, so a cKDTree nearest query is cheap and avoids
+pulling scipy's slower ``griddata`` wrapper into the loop.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def forward_interpolate(flow: np.ndarray) -> np.ndarray:
+    """(H, W, 2) flow at frame t -> (H, W, 2) estimate for frame t+1.
+
+    Points whose destination leaves the open interval (0, W)x(0, H) are
+    dropped (matching the reference's strict inequalities,
+    core/utils/utils.py:43); if nothing survives, returns zeros.
+    """
+    from scipy.spatial import cKDTree  # deferred: scipy only needed here
+
+    flow = np.asarray(flow, dtype=np.float32)
+    if flow.ndim != 3 or flow.shape[2] != 2:
+        raise ValueError(f"flow must be (H, W, 2), got {flow.shape}")
+    ht, wd = flow.shape[:2]
+    dx, dy = flow[..., 0], flow[..., 1]
+    x0, y0 = np.meshgrid(np.arange(wd), np.arange(ht))
+
+    x1 = (x0 + dx).ravel()
+    y1 = (y0 + dy).ravel()
+    dxr, dyr = dx.ravel(), dy.ravel()
+
+    valid = (x1 > 0) & (x1 < wd) & (y1 > 0) & (y1 < ht)
+    if not valid.any():
+        return np.zeros_like(flow)
+    pts = np.stack([x1[valid], y1[valid]], axis=1)
+    vals = np.stack([dxr[valid], dyr[valid]], axis=1)
+
+    query = np.stack([x0.ravel(), y0.ravel()], axis=1)
+    _, idx = cKDTree(pts).query(query, k=1)
+    return vals[idx].reshape(ht, wd, 2).astype(np.float32)

@@ -1,0 +1,3 @@
+from raft_ncup_tpu.viz.flow_viz import flow_to_image, make_colorwheel
+
+__all__ = ["flow_to_image", "make_colorwheel"]

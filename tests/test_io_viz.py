@@ -1,0 +1,194 @@
+"""Tests for flow file I/O, visualization, and warm-start interpolation."""
+
+import numpy as np
+import pytest
+
+from raft_ncup_tpu.io import (
+    read_flo,
+    read_flow_kitti,
+    read_gen,
+    read_image,
+    read_pfm,
+    write_flo,
+    write_flow_kitti,
+    write_pfm,
+)
+from raft_ncup_tpu.ops.warmstart import forward_interpolate
+from raft_ncup_tpu.viz import flow_to_image, make_colorwheel
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+class TestFlo:
+    def test_roundtrip(self, tmp_path, rng):
+        flow = rng.normal(size=(17, 23, 2)).astype(np.float32)
+        path = tmp_path / "a.flo"
+        write_flo(path, flow)
+        np.testing.assert_array_equal(read_flo(path), flow)
+
+    def test_bytes_layout(self, tmp_path):
+        # magic, w, h header then row-major interleaved (u, v) float32.
+        flow = np.zeros((2, 3, 2), np.float32)
+        flow[0, 1] = (5.0, -7.0)
+        path = tmp_path / "a.flo"
+        write_flo(path, flow)
+        raw = path.read_bytes()
+        assert np.frombuffer(raw[:4], "<f4")[0] == pytest.approx(202021.25)
+        assert np.frombuffer(raw[4:12], "<i4").tolist() == [3, 2]
+        body = np.frombuffer(raw[12:], "<f4")
+        assert body[2] == 5.0 and body[3] == -7.0
+
+    def test_bad_magic(self, tmp_path):
+        path = tmp_path / "bad.flo"
+        path.write_bytes(b"\x00" * 32)
+        with pytest.raises(ValueError, match="magic"):
+            read_flo(path)
+
+    def test_read_gen_dispatch(self, tmp_path, rng):
+        flow = rng.normal(size=(6, 8, 2)).astype(np.float32)
+        path = tmp_path / "x.flo"
+        write_flo(path, flow)
+        np.testing.assert_array_equal(read_gen(path), flow)
+
+
+class TestPfm:
+    def test_roundtrip_gray(self, tmp_path, rng):
+        data = rng.normal(size=(11, 7)).astype(np.float32)
+        path = tmp_path / "a.pfm"
+        write_pfm(path, data)
+        np.testing.assert_array_equal(read_pfm(path), data)
+
+    def test_roundtrip_color(self, tmp_path, rng):
+        data = rng.normal(size=(5, 9, 3)).astype(np.float32)
+        path = tmp_path / "a.pfm"
+        write_pfm(path, data)
+        np.testing.assert_array_equal(read_pfm(path), data)
+
+    def test_read_gen_drops_third_channel(self, tmp_path, rng):
+        data = rng.normal(size=(5, 9, 3)).astype(np.float32)
+        path = tmp_path / "a.pfm"
+        write_pfm(path, data)
+        out = read_gen(path)
+        assert out.shape == (5, 9, 2)
+        np.testing.assert_array_equal(out, data[:, :, :2])
+
+    def test_rows_bottom_up(self, tmp_path):
+        # First stored row must be the image's bottom row.
+        data = np.arange(12, dtype=np.float32).reshape(4, 3)
+        path = tmp_path / "a.pfm"
+        write_pfm(path, data)
+        raw = path.read_bytes()
+        body_off = len(raw) - 4 * 12
+        first_stored = np.frombuffer(raw[body_off : body_off + 12], "<f4")
+        np.testing.assert_array_equal(first_stored, data[-1])
+
+
+class TestKitti:
+    def test_roundtrip(self, tmp_path, rng):
+        # Representable values are multiples of 1/64 within +-512.
+        flow = (
+            rng.integers(-512 * 64, 512 * 64, size=(10, 14, 2)) / 64.0
+        ).astype(np.float32)
+        path = tmp_path / "f.png"
+        write_flow_kitti(path, flow)
+        back, valid = read_flow_kitti(path)
+        np.testing.assert_allclose(back, flow, atol=1e-6)
+        np.testing.assert_array_equal(valid, np.ones((10, 14), np.float32))
+
+
+class TestReadImage:
+    def test_grayscale_broadcast(self, tmp_path):
+        from PIL import Image
+
+        img = Image.fromarray(np.arange(20, dtype=np.uint8).reshape(4, 5))
+        path = tmp_path / "g.png"
+        img.save(path)
+        out = read_image(path)
+        assert out.shape == (4, 5, 3)
+        np.testing.assert_array_equal(out[..., 0], out[..., 2])
+
+
+class TestFlowViz:
+    def test_wheel_shape_and_anchors(self):
+        wheel = make_colorwheel()
+        assert wheel.shape == (55, 3)
+        np.testing.assert_array_equal(wheel[0], [255, 0, 0])  # pure red
+        # Wheel ramps stay in [0, 255].
+        assert wheel.min() >= 0 and wheel.max() <= 255
+
+    def test_zero_flow_is_white(self):
+        img = flow_to_image(np.zeros((4, 4, 2), np.float32))
+        assert img.shape == (4, 4, 3)
+        np.testing.assert_array_equal(img, np.full((4, 4, 3), 255, np.uint8))
+
+    def test_leftward_motion_maps_to_cyan_blue(self):
+        # u=-10, v=0: arctan2(-v,-u)=0 -> fk=(0+1)/2*54=27 -> CB segment
+        # (wheel[27] = (0, 209, 255)).
+        flow = np.zeros((2, 2, 2), np.float32)
+        flow[0, 0] = (-10.0, 0.0)
+        img = flow_to_image(flow)
+        r, g, b = img[0, 0]
+        assert b == 255 and r == 0 and 200 <= g <= 215
+
+    def test_unknown_flow_black(self):
+        flow = np.zeros((2, 2, 2), np.float32)
+        flow[1, 1] = (1e8, 0.0)
+        img = flow_to_image(flow)
+        np.testing.assert_array_equal(img[1, 1], [0, 0, 0])
+
+    def test_bgr_flag_reverses_channels(self):
+        flow = np.zeros((2, 2, 2), np.float32)
+        flow[0, 0] = (-3.0, 1.0)
+        rgb = flow_to_image(flow)
+        bgr = flow_to_image(flow, convert_to_bgr=True)
+        np.testing.assert_array_equal(rgb[..., ::-1], bgr)
+
+    def test_fixed_rad_max(self):
+        flow = np.full((3, 3, 2), 0.5, np.float32)
+        a = flow_to_image(flow, rad_max=100.0)
+        # Tiny motion w.r.t. fixed scale -> near-white.
+        assert a.min() > 240
+
+
+class TestForwardInterpolate:
+    def test_zero_flow_fixed_point(self):
+        flow = np.zeros((6, 8, 2), np.float32)
+        np.testing.assert_array_equal(forward_interpolate(flow), flow)
+
+    def test_constant_flow_propagates(self):
+        flow = np.full((8, 12, 2), 2.0, np.float32)
+        out = forward_interpolate(flow)
+        # Every queried pixel's nearest splat carries the same value.
+        np.testing.assert_allclose(out, flow)
+
+    def test_all_out_of_bounds_gives_zeros(self):
+        flow = np.full((4, 4, 2), 100.0, np.float32)
+        out = forward_interpolate(flow)
+        np.testing.assert_array_equal(out, np.zeros_like(flow))
+
+    def test_matches_griddata_reference(self):
+        # Independent check against scipy.interpolate.griddata nearest,
+        # the reference's exact algorithm (core/utils/utils.py:49-53).
+        from scipy import interpolate as si
+
+        rng = np.random.default_rng(3)
+        flow = rng.normal(scale=3.0, size=(10, 11, 2)).astype(np.float32)
+        ht, wd = flow.shape[:2]
+        dx, dy = flow[..., 0], flow[..., 1]
+        x0, y0 = np.meshgrid(np.arange(wd), np.arange(ht))
+        x1, y1 = (x0 + dx).ravel(), (y0 + dy).ravel()
+        valid = (x1 > 0) & (x1 < wd) & (y1 > 0) & (y1 < ht)
+        ref_x = si.griddata(
+            (x1[valid], y1[valid]), dx.ravel()[valid], (x0, y0),
+            method="nearest",
+        )
+        ref_y = si.griddata(
+            (x1[valid], y1[valid]), dy.ravel()[valid], (x0, y0),
+            method="nearest",
+        )
+        out = forward_interpolate(flow)
+        np.testing.assert_allclose(out[..., 0], ref_x, atol=1e-6)
+        np.testing.assert_allclose(out[..., 1], ref_y, atol=1e-6)
