@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Evaluation driver (reference-compatible CLI).
+
+Validates on chairs / sintel / kitti or writes leaderboard submissions
+(reference: evaluate.py:185-272). Checkpoints: an orbax run dir produced
+by our train.py, or a PyTorch ``.pth`` from the reference (imported
+weight-by-weight).
+
+Examples:
+    python evaluate.py --model raft_nc_dbl --dataset sintel \
+        --restore_ckpt checkpoints/raft_nc_sintel
+    python evaluate.py --model raft_nc_dbl --dataset kitti --submission \
+        --restore_ckpt models/raft_nc-kitti.pth
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+
+
+def load_variables(model, model_cfg, restore_ckpt: str | None):
+    """Init variables, then overwrite from the checkpoint (strict for
+    torch files, as in the reference eval — evaluate.py:257)."""
+    import os
+
+    # Parameter shapes are input-size independent (fully convolutional);
+    # init small to keep startup cheap.
+    shape = (1, 64, 96, 3)
+    variables = model.init(jax.random.PRNGKey(0), shape)
+    if not restore_ckpt:
+        return variables
+    if os.path.isdir(restore_ckpt):
+        from raft_ncup_tpu.training.checkpoint import _restore_variables_only
+
+        restored = _restore_variables_only(restore_ckpt)
+        variables["params"] = restored["params"]
+        if "batch_stats" in restored:
+            variables["batch_stats"] = restored["batch_stats"]
+        return variables
+    from raft_ncup_tpu.training.checkpoint import load_torch
+
+    return load_torch(restore_ckpt, variables, strict=True)
+
+
+def main(argv=None) -> None:
+    from raft_ncup_tpu.cli import parse_eval
+    from raft_ncup_tpu.evaluation import (
+        VALIDATORS,
+        create_kitti_submission,
+        create_sintel_submission,
+    )
+    from raft_ncup_tpu.models.raft import RAFT
+
+    args, model_cfg, data_cfg = parse_eval(argv)
+    model = RAFT(model_cfg)
+    variables = load_variables(model, model_cfg, args.restore_ckpt)
+
+    if args.submission:
+        if args.dataset == "sintel":
+            kwargs = {}
+            if args.output_path:
+                kwargs["output_path"] = args.output_path
+            create_sintel_submission(
+                model, variables, data_cfg,
+                warm_start=args.warm_start, write_png=args.write_png,
+                **kwargs,
+            )
+        elif args.dataset == "kitti":
+            kwargs = {}
+            if args.output_path:
+                kwargs["output_path"] = args.output_path
+            create_kitti_submission(
+                model, variables, data_cfg, write_png=args.write_png,
+                **kwargs,
+            )
+        else:
+            raise SystemExit("--submission supports sintel/kitti only")
+        return
+
+    results = VALIDATORS[args.dataset](model, variables, data_cfg)
+    print(results)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

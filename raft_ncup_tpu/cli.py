@@ -1,0 +1,279 @@
+"""Reference-compatible command-line interface.
+
+Accepts the flag surface of the reference's argparse blocks plus its
+reflective flag generator (reference: train.py:264-343,
+core/utils/args.py:8-114) — including ``--final_upsampling=NConvUpsampler``
+-style class-choice flags and ``"[3, 3, 1]"`` int-list values — and
+resolves everything into the typed frozen configs of
+``raft_ncup_tpu.config`` before any model is built (the reference instead
+mutates ``args`` inside model constructors; SURVEY.md §3.4).
+
+TPU-specific additions (not in the reference): ``--data_parallel``,
+``--spatial_parallel`` mesh sizes, per-dataset root overrides, and
+``--synthetic_ok`` for data-free smoke runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+from typing import Optional, Sequence
+
+from raft_ncup_tpu.config import (
+    DataConfig,
+    ModelConfig,
+    TrainConfig,
+    UpsamplerConfig,
+)
+
+
+def str2bool(v: str) -> bool:
+    if isinstance(v, bool):
+        return v
+    if v.lower() in ("yes", "true", "t", "y", "1"):
+        return True
+    if v.lower() in ("no", "false", "f", "n", "0"):
+        return False
+    raise argparse.ArgumentTypeError(f"boolean value expected, got {v!r}")
+
+
+def str2intlist(v: str) -> tuple[int, ...]:
+    """Parse the reference's quoted list syntax ``"[3, 3, 1]"``
+    (reference: core/utils/args.py:174-175)."""
+    out = ast.literal_eval(v)
+    if not isinstance(out, (list, tuple)):
+        raise argparse.ArgumentTypeError(f"int list expected, got {v!r}")
+    return tuple(int(x) for x in out)
+
+
+_UPSAMPLER_CLASSES = {
+    # reference class names (core/upsampler.py) -> our registry kinds
+    "NConvUpsampler": "nconv",
+    "Bilinear": "bilinear",
+    "PacJointUpsampleFull": "pac",
+    "DjifOriginal": "djif",
+}
+
+
+def add_model_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--model", default="raft", help="model variant (train/eval) ")
+    parser.add_argument("--small", action="store_true")
+    parser.add_argument("--dropout", type=float, default=0.0)
+    parser.add_argument("--mixed_precision", action="store_true")
+    parser.add_argument("--align_corners", action="store_true")
+    parser.add_argument("--upsampler_bi", action="store_true",
+                        help="use bilinear final upsampling")
+    parser.add_argument("--freeze_raft", action="store_true")
+    parser.add_argument("--load_pretrained", default=None)
+    parser.add_argument("--corr_impl", default="volume",
+                        choices=["volume", "onthefly", "pallas"])
+
+    # --- reflective upsampler flags (reference: train.py:300-343)
+    parser.add_argument("--final_upsampling", default="NConvUpsampler",
+                        choices=sorted(_UPSAMPLER_CLASSES))
+    parser.add_argument("--final_upsampling_scale", type=int, default=4)
+    parser.add_argument("--final_upsampling_use_data_for_guidance",
+                        type=str2bool, default=True)
+    parser.add_argument("--final_upsampling_channels_to_batch",
+                        type=str2bool, default=True)
+    parser.add_argument("--final_upsampling_use_residuals",
+                        type=str2bool, default=False)
+    parser.add_argument("--final_upsampling_est_on_high_res",
+                        type=str2bool, default=False)
+    parser.add_argument("--interp_net", default="NConvUNet",
+                        choices=["NConvUNet"])
+    parser.add_argument("--interp_net_channels_multiplier", type=int, default=2)
+    parser.add_argument("--interp_net_num_downsampling", type=int, default=1)
+    parser.add_argument("--interp_net_data_pooling", default="conf_based",
+                        choices=["conf_based", "max_pooling"])
+    parser.add_argument("--interp_net_encoder_filter_sz", type=int, default=5)
+    parser.add_argument("--interp_net_decoder_filter_sz", type=int, default=3)
+    parser.add_argument("--interp_net_out_filter_sz", type=int, default=1)
+    parser.add_argument("--interp_net_shared_encoder", type=str2bool, default=True)
+    parser.add_argument("--interp_net_use_double_conv", type=str2bool, default=False)
+    parser.add_argument("--interp_net_use_bias", type=str2bool, default=False)
+    parser.add_argument("--interp_net_pos_fn", default="softplus")
+    parser.add_argument("--weights_est_net", default="Simple",
+                        choices=["Simple", "UNet"])
+    parser.add_argument("--weights_est_net_num_ch", type=str2intlist,
+                        default=(64, 32))
+    parser.add_argument("--weights_est_net_filter_sz", type=str2intlist,
+                        default=(3, 3, 1))
+    parser.add_argument("--weights_est_net_dilation", type=str2intlist,
+                        default=(1, 1, 1))
+
+
+def add_data_args(parser: argparse.ArgumentParser) -> None:
+    d = DataConfig()
+    parser.add_argument("--root_chairs", default=d.root_chairs)
+    parser.add_argument("--root_things", default=d.root_things)
+    parser.add_argument("--root_sintel", default=d.root_sintel)
+    parser.add_argument("--root_kitti", default=d.root_kitti)
+    parser.add_argument("--root_hd1k", default=d.root_hd1k)
+    parser.add_argument("--chairs_split_file", default=d.chairs_split_file)
+    parser.add_argument("--compressed_ft", action="store_true")
+    parser.add_argument("--num_workers", type=int, default=4)
+    parser.add_argument("--synthetic_ok", action="store_true",
+                        help="fall back to procedural data if roots missing")
+
+
+def add_train_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--name", default="raft")
+    parser.add_argument("--stage", required=True,
+                        choices=["chairs", "things", "sintel", "kitti"])
+    parser.add_argument("--restore_ckpt", default=None)
+    parser.add_argument("--validation", type=str, nargs="+", default=[])
+    parser.add_argument("--lr", type=float, default=0.00002)
+    parser.add_argument("--num_steps", type=int, default=100000)
+    parser.add_argument("--batch_size", type=int, default=6)
+    parser.add_argument("--image_size", type=int, nargs="+",
+                        default=[384, 512])
+    parser.add_argument("--gpus", type=int, nargs="+", default=None,
+                        help="accepted for reference-script compatibility; "
+                        "ignored (device mesh comes from --data_parallel)")
+    parser.add_argument("--iters", type=int, default=12)
+    parser.add_argument("--wdecay", type=float, default=0.00005)
+    parser.add_argument("--epsilon", type=float, default=1e-8)
+    parser.add_argument("--clip", type=float, default=1.0)
+    parser.add_argument("--add_noise", action="store_true")
+    parser.add_argument("--gamma", type=float, default=0.8)
+    parser.add_argument("--optimizer", default="adamw", type=str.lower)
+    parser.add_argument("--scheduler", default="cyclic")
+    parser.add_argument("--scheduler_step", type=int, default=20000)
+    parser.add_argument("--val_freq", type=int, default=5000)
+    parser.add_argument("--sum_freq", type=int, default=100)
+    parser.add_argument("--seed", type=int, default=1234)
+    parser.add_argument("--checkpoint_dir", default="checkpoints")
+    parser.add_argument("--data_parallel", type=int, default=None,
+                        help="data-parallel mesh size (default: all devices)")
+    parser.add_argument("--spatial_parallel", type=int, default=1)
+
+
+def model_config_from_args(
+    args: argparse.Namespace, dataset: Optional[str] = None
+) -> ModelConfig:
+    """Resolve a ModelConfig. ``dataset`` controls upsampler BatchNorm
+    (reference: core/upsampler.py:41-46) — for training it is the stage,
+    for eval the --dataset flag."""
+    kind = _UPSAMPLER_CLASSES[args.final_upsampling]
+    if args.upsampler_bi:
+        kind = "bilinear"
+    ups = UpsamplerConfig(
+        kind=kind,
+        scale=args.final_upsampling_scale,
+        use_data_for_guidance=args.final_upsampling_use_data_for_guidance,
+        channels_to_batch=args.final_upsampling_channels_to_batch,
+        use_residuals=args.final_upsampling_use_residuals,
+        est_on_high_res=args.final_upsampling_est_on_high_res,
+        channels_multiplier=args.interp_net_channels_multiplier,
+        num_downsampling=args.interp_net_num_downsampling,
+        encoder_filter_sz=args.interp_net_encoder_filter_sz,
+        decoder_filter_sz=args.interp_net_decoder_filter_sz,
+        out_filter_sz=args.interp_net_out_filter_sz,
+        use_bias=args.interp_net_use_bias,
+        data_pooling=args.interp_net_data_pooling,
+        shared_encoder=args.interp_net_shared_encoder,
+        use_double_conv=args.interp_net_use_double_conv,
+        pos_fn=args.interp_net_pos_fn.lower(),
+        weights_est_net=args.weights_est_net.lower(),
+        weights_est_num_ch=tuple(args.weights_est_net_num_ch),
+        weights_est_filter_sz=tuple(args.weights_est_net_filter_sz),
+        weights_est_dilation=tuple(args.weights_est_net_dilation),
+    )
+    if dataset is None:
+        dataset = getattr(args, "dataset", None) or getattr(args, "stage", "sintel")
+    return ModelConfig(
+        variant=args.model,
+        small=args.small,
+        dropout=args.dropout,
+        mixed_precision=args.mixed_precision,
+        align_corners=args.align_corners,
+        corr_impl=args.corr_impl,
+        dataset=dataset,
+        freeze_raft=args.freeze_raft,
+        upsampler=ups,
+    )
+
+
+def train_config_from_args(args: argparse.Namespace) -> TrainConfig:
+    size = args.image_size
+    return TrainConfig(
+        name=args.name,
+        stage=args.stage,
+        lr=args.lr,
+        num_steps=args.num_steps,
+        batch_size=args.batch_size,
+        image_size=(size[0], size[1]),
+        iters=args.iters,
+        wdecay=args.wdecay,
+        epsilon=args.epsilon,
+        clip=args.clip,
+        gamma=args.gamma,
+        optimizer=args.optimizer,
+        scheduler=args.scheduler,
+        scheduler_step=args.scheduler_step,
+        add_noise=args.add_noise,
+        validation=tuple(args.validation),
+        val_freq=args.val_freq,
+        sum_freq=args.sum_freq,
+        seed=args.seed,
+        restore_ckpt=args.restore_ckpt,
+        load_pretrained=args.load_pretrained,
+        checkpoint_dir=args.checkpoint_dir,
+        data_parallel=args.data_parallel,
+        spatial_parallel=args.spatial_parallel,
+    )
+
+
+def data_config_from_args(args: argparse.Namespace) -> DataConfig:
+    return DataConfig(
+        root_chairs=args.root_chairs,
+        root_things=args.root_things,
+        root_sintel=args.root_sintel,
+        root_kitti=args.root_kitti,
+        root_hd1k=args.root_hd1k,
+        chairs_split_file=args.chairs_split_file,
+        compressed_ft=args.compressed_ft,
+        num_workers=args.num_workers,
+        synthetic_ok=args.synthetic_ok,
+    )
+
+
+def build_train_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description="Train RAFT / RAFT-NCUP on TPU (JAX)"
+    )
+    add_train_args(parser)
+    add_model_args(parser)
+    add_data_args(parser)
+    return parser
+
+
+def build_eval_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description="Evaluate RAFT / RAFT-NCUP on TPU (JAX)"
+    )
+    parser.add_argument("--restore_ckpt", default=None,
+                        help="orbax run dir or torch .pth")
+    parser.add_argument("--dataset", required=True,
+                        choices=["chairs", "sintel", "kitti"])
+    parser.add_argument("--submission", action="store_true",
+                        help="write leaderboard files instead of validating")
+    parser.add_argument("--warm_start", action="store_true")
+    parser.add_argument("--write_png", action="store_true")
+    parser.add_argument("--output_path", default=None)
+    add_model_args(parser)
+    add_data_args(parser)
+    return parser
+
+
+def parse_train(argv: Optional[Sequence[str]] = None):
+    args = build_train_parser().parse_args(argv)
+    model_cfg = model_config_from_args(args, dataset=args.stage)
+    return args, model_cfg, train_config_from_args(args), data_config_from_args(args)
+
+
+def parse_eval(argv: Optional[Sequence[str]] = None):
+    args = build_eval_parser().parse_args(argv)
+    model_cfg = model_config_from_args(args, dataset=args.dataset)
+    return args, model_cfg, data_config_from_args(args)

@@ -1,0 +1,173 @@
+"""Threaded, host-sharded batch loader.
+
+The reference feeds training from a 4-worker PyTorch DataLoader
+(reference: core/datasets.py:240-241). Here the loader is a plain Python
+iterator designed for the JAX input model: it yields dicts of stacked
+numpy arrays (one host-local batch, ready for ``jax.device_put`` against a
+batch sharding), shards sample indices across hosts by
+``jax.process_index()``, decodes/augments in a thread pool (cv2/PIL
+release the GIL), and keeps a bounded prefetch queue of ready batches.
+
+Determinism: each sample's augmentation RNG is
+``np.random.default_rng(SeedSequence(seed, epoch, index))`` — independent
+of worker scheduling, stable across restarts.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+def _stack_batch(samples: list[dict]) -> dict:
+    # Preserve native dtypes: images stay uint8 (4x less host memory and
+    # host->device traffic than float32; the model normalizes on device),
+    # flow/valid stay float32.
+    out = {}
+    for key in samples[0]:
+        vals = [s[key] for s in samples]
+        if key == "extra_info":
+            out[key] = vals
+        else:
+            out[key] = np.stack([np.asarray(v) for v in vals])
+            if out[key].dtype not in (np.uint8, np.float32):
+                out[key] = out[key].astype(np.float32)
+    return out
+
+
+class FlowLoader:
+    """Iterate shuffled, augmented, host-sharded batches forever.
+
+    ``shard_index``/``num_shards`` default to this host's
+    ``jax.process_index()`` / ``jax.process_count()`` so each host of a
+    multi-host pod reads a disjoint slice of every epoch — the TPU
+    replacement for the reference's single-process DataLoader.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        drop_last: bool = True,
+        seed: int = 1234,
+        num_workers: int = 4,
+        prefetch: int = 2,
+        shard_index: Optional[int] = None,
+        num_shards: Optional[int] = None,
+    ):
+        if shard_index is None or num_shards is None:
+            import jax
+
+            shard_index = jax.process_index()
+            num_shards = jax.process_count()
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.num_workers = num_workers
+        self.prefetch = prefetch
+        self.shard_index = shard_index
+        self.num_shards = num_shards
+        if len(self) == 0:
+            raise ValueError(
+                f"dataset of {len(dataset)} samples yields zero batches for "
+                f"shard {shard_index}/{num_shards} at batch_size={batch_size}"
+                f" (drop_last={drop_last}) — check the dataset roots"
+            )
+
+    def _shard_size(self) -> int:
+        return len(
+            range(self.shard_index, len(self.dataset), self.num_shards)
+        )
+
+    def __len__(self) -> int:
+        n = self._shard_size()
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def _epoch_indices(self, epoch: int) -> np.ndarray:
+        n = len(self.dataset)
+        if self.shuffle:
+            order = np.random.default_rng(
+                np.random.SeedSequence([self.seed, epoch])
+            ).permutation(n)
+        else:
+            order = np.arange(n)
+        return order[self.shard_index :: self.num_shards]
+
+    def _load_one(self, epoch: int, index: int) -> dict:
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, epoch, int(index)])
+        )
+        return self.dataset.sample(int(index), rng)
+
+    def batches(self, start_epoch: int = 0) -> Iterator[dict]:
+        """Infinite stream of batches, epoch after epoch."""
+        stop = threading.Event()
+        out: queue.Queue = queue.Queue(maxsize=max(1, self.prefetch))
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    out.put(item, timeout=0.5)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                with ThreadPoolExecutor(self.num_workers) as pool:
+                    epoch = start_epoch
+                    while not stop.is_set():
+                        idx = self._epoch_indices(epoch)
+                        limit = (
+                            len(idx) - len(idx) % self.batch_size
+                            if self.drop_last
+                            else len(idx)
+                        )
+                        for s in range(0, limit, self.batch_size):
+                            chunk = idx[s : s + self.batch_size]
+                            samples = list(
+                                pool.map(
+                                    lambda i: self._load_one(epoch, i), chunk
+                                )
+                            )
+                            if not _put(_stack_batch(samples)):
+                                return
+                        epoch += 1
+            except BaseException as e:  # surface worker errors to consumer
+                _put(e)
+
+        thread = threading.Thread(target=producer, daemon=True)
+        thread.start()
+        try:
+            while True:
+                item = out.get()
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+
+    def one_epoch(self, epoch: int = 0) -> Iterator[dict]:
+        """A single pass over this host's shard (for validation loops)."""
+        idx = self._epoch_indices(epoch)
+        limit = (
+            len(idx) - len(idx) % self.batch_size if self.drop_last else len(idx)
+        )
+        with ThreadPoolExecutor(self.num_workers) as pool:
+            for s in range(0, limit, self.batch_size):
+                chunk = idx[s : s + self.batch_size]
+                samples = list(
+                    pool.map(lambda i: self._load_one(epoch, i), chunk)
+                )
+                yield _stack_batch(samples)

@@ -1,0 +1,270 @@
+"""Validation and leaderboard-submission drivers.
+
+Mirrors the reference eval surface (reference: evaluate.py:22-182):
+``validate_chairs`` (EPE @ 24 iters), ``validate_sintel`` (clean+final
+EPE and 1/3/5px @ 32 iters), ``validate_kitti`` (EPE + F1 @ 24 iters),
+and the Sintel/KITTI submission writers (warm-start supported for
+Sintel).
+
+TPU shape discipline: frames stream one at a time with dataset-dependent
+sizes, so the jitted test-mode forward is cached per padded input shape
+(Sintel is one shape; KITTI has a handful) — each unique shape compiles
+once instead of every frame.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_ncup_tpu.config import DataConfig
+from raft_ncup_tpu.data import datasets as ds_mod
+from raft_ncup_tpu.io import write_flo, write_flow_kitti
+from raft_ncup_tpu.models.raft import RAFT
+from raft_ncup_tpu.ops import InputPadder, forward_interpolate
+from raft_ncup_tpu.viz import flow_to_image
+
+
+class _ShapeCachedForward:
+    """jit cache keyed by (padded shape, iters, warm-start presence)."""
+
+    def __init__(self, model: RAFT, variables: dict):
+        self.model = model
+        self.variables = variables
+        self._fns: dict = {}
+
+    def __call__(
+        self,
+        image1: np.ndarray,
+        image2: np.ndarray,
+        iters: int,
+        flow_init: Optional[np.ndarray] = None,
+    ):
+        key = (image1.shape, iters, flow_init is not None)
+        if key not in self._fns:
+            if flow_init is None:
+
+                def fn(v, i1, i2):
+                    return self.model.apply(
+                        v, i1, i2, iters=iters, test_mode=True
+                    )
+
+            else:
+
+                def fn(v, i1, i2, finit):
+                    return self.model.apply(
+                        v, i1, i2, iters=iters, flow_init=finit,
+                        test_mode=True,
+                    )
+
+            self._fns[key] = jax.jit(fn)
+        args = (jnp.asarray(image1), jnp.asarray(image2))
+        if flow_init is not None:
+            args += (jnp.asarray(flow_init),)
+        flow_lr, flow_up = self._fns[key](self.variables, *args)
+        return np.asarray(flow_lr), np.asarray(flow_up)
+
+
+def _pair_arrays(sample: dict) -> tuple[np.ndarray, np.ndarray]:
+    img1 = np.asarray(sample["image1"], np.float32)[None]
+    img2 = np.asarray(sample["image2"], np.float32)[None]
+    return img1, img2
+
+
+def validate_chairs(
+    model: RAFT, variables: dict, data_cfg: Optional[DataConfig] = None,
+    iters: int = 24,
+) -> dict:
+    """FlyingChairs validation-split EPE (reference: evaluate.py:90-108)."""
+    cfg = data_cfg or DataConfig()
+    dataset = ds_mod.FlyingChairs(
+        None, split="validation", root=cfg.root_chairs,
+        split_file=cfg.chairs_split_file,
+    )
+    if len(dataset) == 0:
+        print(f"validate_chairs: no data under {cfg.root_chairs}, skipping")
+        return {}
+    fwd = _ShapeCachedForward(model, variables)
+    epe_list = []
+    for i in range(len(dataset)):
+        s = dataset.sample(i)
+        img1, img2 = _pair_arrays(s)
+        _, flow_up = fwd(img1, img2, iters)
+        epe = np.sqrt(((flow_up[0] - s["flow"]) ** 2).sum(-1))
+        epe_list.append(epe.ravel())
+    epe = float(np.concatenate(epe_list).mean())
+    print(f"Validation Chairs EPE: {epe:f}")
+    return {"chairs": epe}
+
+
+def validate_sintel(
+    model: RAFT, variables: dict, data_cfg: Optional[DataConfig] = None,
+    iters: int = 32,
+) -> dict:
+    """Sintel train-split clean+final EPE / 1px / 3px / 5px
+    (reference: evaluate.py:111-143)."""
+    cfg = data_cfg or DataConfig()
+    fwd = _ShapeCachedForward(model, variables)
+    results = {}
+    for dstype in ("clean", "final"):
+        dataset = ds_mod.MpiSintel(
+            None, split="training", root=cfg.root_sintel, dstype=dstype
+        )
+        if len(dataset) == 0:
+            print(
+                f"validate_sintel: no {dstype} data under "
+                f"{cfg.root_sintel}, skipping"
+            )
+            continue
+        epe_list = []
+        for i in range(len(dataset)):
+            s = dataset.sample(i)
+            img1, img2 = _pair_arrays(s)
+            padder = InputPadder(img1.shape)
+            img1, img2 = padder.pad(img1, img2)
+            _, flow_up = fwd(np.asarray(img1), np.asarray(img2), iters)
+            flow = np.asarray(padder.unpad(jnp.asarray(flow_up))[0])
+            epe = np.sqrt(((flow - s["flow"]) ** 2).sum(-1))
+            epe_list.append(epe.ravel())
+        epe_all = np.concatenate(epe_list)
+        epe = float(epe_all.mean())
+        px1, px3, px5 = (float((epe_all < t).mean()) for t in (1, 3, 5))
+        print(
+            f"Validation ({dstype}) EPE: {epe:f}, 1px: {px1:f}, "
+            f"3px: {px3:f}, 5px: {px5:f}"
+        )
+        results[dstype] = epe
+        results.update(
+            {f"{dstype}_1px": px1, f"{dstype}_3px": px3, f"{dstype}_5px": px5}
+        )
+    return results
+
+
+def validate_kitti(
+    model: RAFT, variables: dict, data_cfg: Optional[DataConfig] = None,
+    iters: int = 24,
+) -> dict:
+    """KITTI-2015 train-split EPE + F1 (reference: evaluate.py:146-182).
+    F1 = % of valid pixels with epe > 3 and epe/mag > 0.05."""
+    cfg = data_cfg or DataConfig()
+    dataset = ds_mod.KITTI(None, split="training", root=cfg.root_kitti)
+    if len(dataset) == 0:
+        print(f"validate_kitti: no data under {cfg.root_kitti}, skipping")
+        return {}
+    fwd = _ShapeCachedForward(model, variables)
+    epe_list, out_list = [], []
+    for i in range(len(dataset)):
+        s = dataset.sample(i)
+        img1, img2 = _pair_arrays(s)
+        padder = InputPadder(img1.shape, mode="kitti")
+        img1, img2 = padder.pad(img1, img2)
+        _, flow_up = fwd(np.asarray(img1), np.asarray(img2), iters)
+        flow = np.asarray(padder.unpad(jnp.asarray(flow_up))[0])
+
+        epe = np.sqrt(((flow - s["flow"]) ** 2).sum(-1)).ravel()
+        mag = np.sqrt((s["flow"] ** 2).sum(-1)).ravel()
+        val = s["valid"].ravel() >= 0.5
+        out = (epe > 3.0) & ((epe / np.maximum(mag, 1e-12)) > 0.05)
+        epe_list.append(epe[val].mean())
+        out_list.append(out[val])
+    epe = float(np.mean(epe_list))
+    f1 = 100.0 * float(np.concatenate(out_list).mean())
+    print(f"Validation KITTI: {epe:f}, {f1:f}")
+    return {"kitti-epe": epe, "kitti-f1": f1}
+
+
+def create_sintel_submission(
+    model: RAFT,
+    variables: dict,
+    data_cfg: Optional[DataConfig] = None,
+    iters: int = 32,
+    warm_start: bool = False,
+    output_path: str = "sintel_submission",
+    write_png: bool = False,
+) -> None:
+    """Write Sintel leaderboard .flo files (reference: evaluate.py:22-57),
+    optionally warm-starting each sequence from the previous frame's
+    forward-interpolated low-res flow."""
+    cfg = data_cfg or DataConfig()
+    fwd = _ShapeCachedForward(model, variables)
+    for dstype in ("clean", "final"):
+        dataset = ds_mod.MpiSintel(
+            None, split="test", root=cfg.root_sintel, dstype=dstype
+        )
+        flow_prev, sequence_prev = None, None
+        for i in range(len(dataset)):
+            s = dataset.sample(i)
+            sequence, frame = s["extra_info"]
+            if sequence != sequence_prev:
+                flow_prev = None
+            img1 = np.asarray(s["image1"], np.float32)[None]
+            img2 = np.asarray(s["image2"], np.float32)[None]
+            padder = InputPadder(img1.shape)
+            img1, img2 = padder.pad(img1, img2)
+            flow_lr, flow_up = fwd(
+                np.asarray(img1), np.asarray(img2), iters, flow_init=flow_prev
+            )
+            flow = np.asarray(padder.unpad(jnp.asarray(flow_up))[0])
+            if warm_start:
+                flow_prev = forward_interpolate(flow_lr[0])[None]
+
+            out_dir = os.path.join(output_path, dstype, sequence)
+            os.makedirs(out_dir, exist_ok=True)
+            write_flo(
+                os.path.join(out_dir, f"frame{frame + 1:04d}.flo"), flow
+            )
+            if write_png:
+                import cv2
+
+                png_dir = os.path.join(output_path + "_png", dstype, sequence)
+                os.makedirs(png_dir, exist_ok=True)
+                cv2.imwrite(
+                    os.path.join(png_dir, f"frame{frame + 1:04d}.png"),
+                    flow_to_image(flow, convert_to_bgr=True),
+                )
+            sequence_prev = sequence
+
+
+def create_kitti_submission(
+    model: RAFT,
+    variables: dict,
+    data_cfg: Optional[DataConfig] = None,
+    iters: int = 24,
+    output_path: str = "kitti_submission",
+    write_png: bool = False,
+) -> None:
+    """Write KITTI leaderboard 16-bit pngs (reference: evaluate.py:60-87)."""
+    cfg = data_cfg or DataConfig()
+    dataset = ds_mod.KITTI(None, split="testing", root=cfg.root_kitti)
+    fwd = _ShapeCachedForward(model, variables)
+    os.makedirs(output_path, exist_ok=True)
+    if write_png:
+        os.makedirs(output_path + "_png", exist_ok=True)
+    for i in range(len(dataset)):
+        s = dataset.sample(i)
+        (frame_id,) = s["extra_info"]
+        img1 = np.asarray(s["image1"], np.float32)[None]
+        img2 = np.asarray(s["image2"], np.float32)[None]
+        padder = InputPadder(img1.shape, mode="kitti")
+        img1, img2 = padder.pad(img1, img2)
+        _, flow_up = fwd(np.asarray(img1), np.asarray(img2), iters)
+        flow = np.asarray(padder.unpad(jnp.asarray(flow_up))[0])
+        write_flow_kitti(os.path.join(output_path, frame_id), flow)
+        if write_png:
+            import cv2
+
+            cv2.imwrite(
+                os.path.join(output_path + "_png", frame_id),
+                flow_to_image(flow, convert_to_bgr=True),
+            )
+
+
+VALIDATORS = {
+    "chairs": validate_chairs,
+    "sintel": validate_sintel,
+    "kitti": validate_kitti,
+}

@@ -1,0 +1,334 @@
+"""PAC / DJIF / joint-bilateral upsampler heads (ablation baselines).
+
+JAX re-make of the reference's comparison upsamplers (reference:
+core/pac_upsampler.py:67-251 and the wrappers at core/upsampler.py:223-242).
+The hand-written autograd machinery of the original is unnecessary here —
+the PAC primitives in ``raft_ncup_tpu.ops.pac`` are plain differentiable
+functions.
+
+All heads share the upsampler interface ``__call__(x_lowres, guidance,
+train=False) -> x_highres`` with channel-last tensors; multi-channel
+targets fold channels into the batch like the reference's
+``convert_to_single_channel`` (reference: core/pac_upsampler.py:16-36).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from raft_ncup_tpu.config import UpsamplerConfig
+from raft_ncup_tpu.nn.layers import Conv2d
+from raft_ncup_tpu.ops.pac import (
+    extract_patches,
+    pac_gaussian_kernel,
+    pacconv_transpose2d,
+    zero_stuff_mask,
+)
+
+
+def _fold_channels(x: jax.Array) -> tuple[jax.Array, int]:
+    """(B, H, W, C) -> (B*C, H, W, 1)."""
+    B, H, W, C = x.shape
+    if C == 1:
+        return x, 1
+    return x.transpose(0, 3, 1, 2).reshape(B * C, H, W, 1), C
+
+
+def _unfold_channels(x: jax.Array, ch: int) -> jax.Array:
+    if ch == 1:
+        return x
+    BC, H, W, one = x.shape
+    return x.reshape(BC // ch, ch, H, W).transpose(0, 2, 3, 1)
+
+
+def _repeat_for_channels(x: jax.Array, ch: int) -> jax.Array:
+    """Tile guidance along batch to match folded channels."""
+    if ch == 1:
+        return x
+    B, H, W, C = x.shape
+    return jnp.repeat(x, ch, axis=0)
+
+
+def _resize_half_pixel(x: jax.Array, out_hw: tuple[int, int]) -> jax.Array:
+    """align_corners=False bilinear (torch F.interpolate default)."""
+    B, H, W, C = x.shape
+    return jax.image.resize(
+        x, (B, out_hw[0], out_hw[1], C), method="bilinear"
+    )
+
+
+class PacConvTranspose2d(nn.Module):
+    """Guided 2x-or-more upsampling convolution (reference:
+    core/pac_modules.py:628-722 module, native forward :462-467).
+
+    ``__call__(x_low, guide_high)``: the Gaussian adapting kernel comes
+    from the output-resolution guidance; weight layout (k*k, Cin, Cout).
+    """
+
+    in_ch: int
+    out_ch: int
+    kernel_size: int = 5
+    stride: int = 2
+    padding: int = 2
+    output_padding: int = 1
+    normalize_kernel: bool = False
+    use_bias: bool = True
+    identity_init: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array, guide: jax.Array) -> jax.Array:
+        k = self.kernel_size
+
+        if self.identity_init:
+            eye = jnp.zeros((k * k, self.in_ch, self.out_ch))
+            for c in range(min(self.in_ch, self.out_ch)):
+                eye = eye.at[:, c, c].set(1.0)
+            weight = self.param("weight", lambda rng: eye)
+        else:
+            # Torch ConvTranspose2d default init: U(-b, b), b = 1/sqrt(fan).
+            bound = 1.0 / math.sqrt(self.in_ch * k * k)
+            weight = self.param(
+                "weight",
+                lambda rng: jax.random.uniform(
+                    rng, (k * k, self.in_ch, self.out_ch),
+                    minval=-bound, maxval=bound,
+                ),
+            )
+        bias = (
+            self.param(
+                "bias",
+                lambda rng: jax.random.uniform(
+                    rng, (self.out_ch,),
+                    minval=-1.0 / math.sqrt(self.in_ch * k * k),
+                    maxval=1.0 / math.sqrt(self.in_ch * k * k),
+                ),
+            )
+            if self.use_bias
+            else None
+        )
+
+        kernel = pac_gaussian_kernel(guide, k)
+        if self.normalize_kernel:
+            # Taps landing on stuffed zeros contribute nothing; normalize
+            # over the real-sample taps (reference:
+            # core/pac_modules.py:352-360,417-424 with transposed mask).
+            pattern = zero_stuff_mask(x.shape[1:3], self.stride, x.dtype)
+            span = (k - 1)
+            pad = span - self.padding
+            pat = extract_patches(
+                pattern, k,
+                pad_lo=(pad, pad),
+                pad_hi=(pad + self.output_padding, pad + self.output_padding),
+            )[..., 0]
+            kernel = kernel * pat
+            kernel = kernel / jnp.maximum(
+                kernel.sum(axis=3, keepdims=True), 1e-12
+            )
+        return pacconv_transpose2d(
+            x, kernel, weight, bias,
+            stride=self.stride, padding=self.padding,
+            output_padding=self.output_padding,
+        )
+
+
+class PacJointUpsample(nn.Module):
+    """Guided upsampler with target/guidance/final branches and log2(factor)
+    PacConvTranspose2d stages (reference: core/pac_upsampler.py:153-251)."""
+
+    factor: int
+    channels: int = 1
+    guide_channels: int = 3
+    n_t_layers: int = 3
+    n_g_layers: int = 3
+    n_f_layers: int = 2
+    n_filters: int = 32
+    k_ch: int = 16
+    f_sz_1: int = 5
+    f_sz_2: int = 5
+
+    @nn.compact
+    def __call__(
+        self, x_lowres: jax.Array, guidance: jax.Array, *, train: bool = False
+    ) -> jax.Array:
+        assert math.log2(self.factor) % 1 == 0, "factor must be a power of 2"
+        num_ups = int(math.log2(self.factor))
+        x, ch0 = _fold_channels(x_lowres)
+
+        # Target branch at low res.
+        for li in range(self.n_t_layers):
+            x = Conv2d(self.n_filters, self.f_sz_1, name=f"t_conv{li + 1}")(x)
+            if li < self.n_t_layers - 1:
+                x = jax.nn.relu(x)
+
+        # Guidance branch emits k_ch kernel-feature channels per stage.
+        g = guidance
+        for li in range(self.n_g_layers):
+            out_ch = (
+                self.k_ch * num_ups
+                if li == self.n_g_layers - 1
+                else self.n_filters
+            )
+            g = Conv2d(out_ch, self.f_sz_1, name=f"g_conv{li + 1}")(g)
+            if li < self.n_g_layers - 1:
+                g = jax.nn.relu(g)
+
+        # Upsampling stages: guide features resized to each stage's output
+        # resolution (reference: core/pac_upsampler.py:239-248).
+        H, W = x_lowres.shape[1:3]
+        for i in range(num_ups):
+            scale = 2 ** (i + 1)
+            g_cur = g[..., i * self.k_ch : (i + 1) * self.k_ch]
+            if scale != self.factor:
+                g_cur = _resize_half_pixel(
+                    g_cur, (H * scale, W * scale)
+                )
+            g_cur = _repeat_for_channels(g_cur, ch0)
+            x = PacConvTranspose2d(
+                self.n_filters,
+                self.n_filters,
+                kernel_size=self.f_sz_2,
+                stride=2,
+                padding=(self.f_sz_2 - 1) // 2,
+                output_padding=self.f_sz_2 % 2,
+                name=f"up_convt{i + 1}",
+            )(x, g_cur)
+            x = jax.nn.relu(x)
+
+        # Final prediction branch.
+        for li in range(self.n_f_layers):
+            out_ch = 1 if li == self.n_f_layers - 1 else self.n_filters
+            x = Conv2d(out_ch, self.f_sz_1, name=f"f_conv{li + 1}")(x)
+            if li < self.n_f_layers - 1:
+                x = jax.nn.relu(x)
+
+        return _unfold_channels(x, ch0)
+
+
+class DJIF(nn.Module):
+    """Deep joint image filtering (reference: core/pac_upsampler.py:105-145):
+    bilinear-upsample the target, then CNN branches for target and guidance
+    fused by a joint branch."""
+
+    factor: int
+    channels: int = 1
+    guide_channels: int = 3
+    fs: Sequence[int] = (9, 1, 5)
+    ns_tg: Sequence[int] = (96, 48, 1)
+    ns_f: Sequence[int] = (64, 32)
+
+    @nn.compact
+    def __call__(
+        self, x_lowres: jax.Array, guidance: jax.Array, *, train: bool = False
+    ) -> jax.Array:
+        x, ch0 = _fold_channels(x_lowres)
+        if x.shape[2] < guidance.shape[2]:
+            x = _resize_half_pixel(
+                x, (x.shape[1] * self.factor, x.shape[2] * self.factor)
+            )
+
+        def branch(v, prefix):
+            for li, (n, f) in enumerate(zip(self.ns_tg, self.fs)):
+                v = Conv2d(n, f, name=f"{prefix}_conv{li + 1}")(v)
+                if li < len(self.ns_tg) - 1:
+                    v = jax.nn.relu(v)
+            return v
+
+        t = branch(x, "t")
+        g = branch(guidance, "g")
+        g = _repeat_for_channels(g, ch0)
+
+        v = jnp.concatenate([t, g], axis=-1)
+        chans = tuple(self.ns_f) + (1,)
+        for li, (n, f) in enumerate(zip(chans, self.fs)):
+            v = Conv2d(n, f, name=f"j_conv{li + 1}")(v)
+            if li < len(chans) - 1:
+                v = jax.nn.relu(v)
+        return _unfold_channels(v, ch0)
+
+
+class JointBilateral(nn.Module):
+    """Classic joint bilateral upsampling as a fixed-weight PAC transpose
+    conv over [color * scale_color, position * scale_space] guidance
+    (reference: core/pac_upsampler.py:67-93)."""
+
+    factor: int
+    channels: int = 2
+    kernel_size: int = 5
+    scale_space: float = 0.125
+    scale_color: float = 1.0
+
+    @nn.compact
+    def __call__(
+        self, x_lowres: jax.Array, guidance: jax.Array, *, train: bool = False
+    ) -> jax.Array:
+        x, ch0 = _fold_channels(x_lowres)
+        B, H, W, C = guidance.shape
+        yy = jnp.arange(H, dtype=guidance.dtype)[None, :, None, None]
+        xx = jnp.arange(W, dtype=guidance.dtype)[None, None, :, None]
+        guide = jnp.concatenate(
+            [
+                guidance * self.scale_color,
+                jnp.broadcast_to(yy, (B, H, W, 1)) * self.scale_space,
+                jnp.broadcast_to(xx, (B, H, W, 1)) * self.scale_space,
+            ],
+            axis=-1,
+        )
+        guide = _repeat_for_channels(guide, ch0)
+        k, f = self.kernel_size, self.factor
+        out = PacConvTranspose2d(
+            1,
+            1,
+            kernel_size=k,
+            stride=f,
+            padding=1 + (k - f - 1) // 2,
+            output_padding=(k - f) % 2,
+            normalize_kernel=True,
+            use_bias=False,
+            identity_init=True,
+            name="convt",
+        )(x, guide)
+        return _unfold_channels(out, ch0)
+
+
+class _PacHead(nn.Module):
+    """Adapter giving PAC/DJIF heads the registry interface."""
+
+    cfg: UpsamplerConfig
+    kind: str
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(
+        self, x_lowres: jax.Array, guidance: jax.Array, *, train: bool = False
+    ) -> jax.Array:
+        C = x_lowres.shape[-1]
+        Gc = guidance.shape[-1]
+        # Guidance arrives at the input (low) resolution from the GRU
+        # hidden state; the heads want it at output resolution (reference
+        # wires full-res RGB guidance; here it is upsampled feature
+        # guidance).
+        H, W = x_lowres.shape[1:3]
+        s = self.cfg.scale
+        guide_hr = _resize_half_pixel(guidance, (H * s, W * s))
+        if self.kind == "pac":
+            head = PacJointUpsample(
+                factor=s, channels=C, guide_channels=Gc, name="pac"
+            )
+        else:
+            head = DJIF(
+                factor=s, channels=C, guide_channels=Gc, name="djif"
+            )
+        return head(x_lowres, guide_hr, train=train)
+
+
+def build_pac_upsampler(
+    cfg: UpsamplerConfig, dtype: Any = None, name: str = "upsampler"
+) -> nn.Module:
+    """Factory entry used by the upsampler registry (reference wrapper
+    classes: core/upsampler.py:223-242)."""
+    return _PacHead(cfg, kind=cfg.kind, dtype=dtype, name=name)

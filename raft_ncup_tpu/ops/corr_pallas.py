@@ -1,0 +1,191 @@
+"""Pallas TPU kernel for the multi-scale correlation-window lookup.
+
+The XLA paths (raft_ncup_tpu.ops.corr) express the (2r+1)^2-tap bilinear
+window sample as a general gather. This kernel exploits the window's
+structure instead: every tap of a query's window shares the same
+fractional offset — the window is an integer-aligned grid shifted by one
+sub-pixel amount — so the whole K x K window equals a 2 x 2 bilinear blend
+of a (K+1) x (K+1) integer-aligned patch of the volume. Per query that is
+one dynamic-start patch load from VMEM plus four shifted multiply-adds,
+with no gather anywhere.
+
+Zero-padding semantics (out-of-bounds taps contribute zero, matching
+``grid_sample``) come from pre-padding each level with K+2 zeros per side:
+window starts are clamped into the padded array, and any fully-OOB window
+lands entirely inside the zero margin.
+
+The kernel is forward-only; ``corr_lookup_pallas`` wraps it in a
+``jax.custom_vjp`` whose backward runs the XLA on-the-fly path's VJP, so
+the op stays trainable. (reference semantics: core/corr.py:23-44)
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_ncup_tpu.ops.corr import (
+    _pool_fmap_pyramid,
+    corr_lookup_onthefly,
+)
+
+_VMEM_BUDGET = 8 * 1024 * 1024  # soft cap per volume block
+
+
+def _query_block(hp: int, wp: int) -> int:
+    """Largest power-of-two query block whose volume slab fits the budget."""
+    q = 256
+    while q > 8 and q * hp * wp * 4 > _VMEM_BUDGET:
+        q //= 2
+    return q
+
+
+def _lookup_kernel(coords_ref, vol_ref, out_ref, *, radius, pad, level):
+    """One (query-block) program: sample the K x K window per query.
+
+    coords_ref: (Q, 2) float32 — full-res query centers (x, y).
+    vol_ref:    (Q, Hp, Wp) float32 — per-query padded volume slab.
+    out_ref:    (Q, K*K) float32 — tap values, x-major (reference tap
+                order: core/corr.py:31-37).
+    """
+    K = 2 * radius + 1
+    Hp, Wp = vol_ref.shape[1], vol_ref.shape[2]
+    inv = 1.0 / (2.0**level)
+
+    def body(q, _):
+        cx = coords_ref[q, 0] * inv
+        cy = coords_ref[q, 1] * inv
+        x0 = jnp.floor(cx)
+        y0 = jnp.floor(cy)
+        fx = cx - x0
+        fy = cy - y0
+        ix = jnp.clip(x0.astype(jnp.int32) - radius + pad, 0, Wp - (K + 1))
+        iy = jnp.clip(y0.astype(jnp.int32) - radius + pad, 0, Hp - (K + 1))
+        patch = pl.load(
+            vol_ref, (q, pl.ds(iy, K + 1), pl.ds(ix, K + 1))
+        )  # (K+1, K+1) rows = y, cols = x
+        win = (
+            (1 - fy) * (1 - fx) * patch[:K, :K]
+            + (1 - fy) * fx * patch[:K, 1:]
+            + fy * (1 - fx) * patch[1:, :K]
+            + fy * fx * patch[1:, 1:]
+        )
+        # win[y_tap, x_tap] -> channel order x-major (i * K + j with i = x).
+        out_ref[q, :] = win.T.reshape(K * K)
+        return 0
+
+    jax.lax.fori_loop(0, out_ref.shape[0], body, 0)
+
+
+def _lookup_one_level(
+    vol: jax.Array,  # (N, Hl, Wl) per-query volume, N = B*H*W
+    coords: jax.Array,  # (N, 2)
+    radius: int,
+    level: int,
+    interpret: bool = False,
+) -> jax.Array:
+    N, Hl, Wl = vol.shape
+    K = 2 * radius + 1
+    pad = K + 2
+    volp = jnp.pad(vol, ((0, 0), (pad, pad), (pad, pad)))
+    Hp, Wp = Hl + 2 * pad, Wl + 2 * pad
+
+    qblk = _query_block(Hp, Wp)
+    n_pad = (-N) % qblk
+    if n_pad:
+        volp = jnp.pad(volp, ((0, n_pad), (0, 0), (0, 0)))
+        coords = jnp.pad(coords, ((0, n_pad), (0, 0)))
+    n_blocks = (N + n_pad) // qblk
+
+    out = pl.pallas_call(
+        functools.partial(
+            _lookup_kernel, radius=radius, pad=pad, level=level
+        ),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((qblk, 2), lambda i: (i, 0)),
+            pl.BlockSpec((qblk, Hp, Wp), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((qblk, K * K), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((N + n_pad, K * K), jnp.float32),
+        interpret=interpret,
+    )(coords.astype(jnp.float32), volp.astype(jnp.float32))
+    return out[:N]
+
+
+def _forward(
+    fmap1: jax.Array,
+    fmap2: jax.Array,
+    coords: jax.Array,
+    radius: int,
+    num_levels: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Materialize the pyramid (einsum on the MXU), then kernel-sample it."""
+    B, H, W, C = fmap1.shape
+    f1 = fmap1.reshape(B, H * W, C).astype(jnp.float32)
+    f2_levels = _pool_fmap_pyramid(fmap2.astype(jnp.float32), num_levels)
+    scale = 1.0 / math.sqrt(C)
+
+    cflat = coords.astype(jnp.float32).reshape(B * H * W, 2)
+    outs = []
+    for lvl, f2l in enumerate(f2_levels):
+        Hl, Wl = f2l.shape[1], f2l.shape[2]
+        vol = (
+            jnp.einsum(
+                "bqc,byxc->bqyx",
+                f1,
+                f2l,
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        ).reshape(B * H * W, Hl, Wl)
+        outs.append(
+            _lookup_one_level(vol, cflat, radius, lvl, interpret=interpret)
+        )
+    K = 2 * radius + 1
+    return jnp.concatenate(outs, axis=-1).reshape(
+        B, H, W, num_levels * K * K
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def corr_lookup_pallas(
+    fmap1: jax.Array,
+    fmap2: jax.Array,
+    coords: jax.Array,
+    radius: int,
+    num_levels: int = 4,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused correlation lookup: (B,H,W,C) x2 + (B,H,W,2) ->
+    (B, H, W, L*(2r+1)^2). Equivalent to the XLA paths in
+    ``raft_ncup_tpu.ops.corr`` up to float associativity."""
+    return _forward(fmap1, fmap2, coords, radius, num_levels, interpret)
+
+
+def _fwd(fmap1, fmap2, coords, radius, num_levels, interpret):
+    out = _forward(fmap1, fmap2, coords, radius, num_levels, interpret)
+    return out, (fmap1, fmap2, coords)
+
+
+def _bwd(radius, num_levels, interpret, res, g):
+    fmap1, fmap2, coords = res
+    # Backward through the mathematically equivalent XLA implementation —
+    # autodiff of the gather path gives exact gradients for the same
+    # function value.
+    _, vjp = jax.vjp(
+        lambda a, b, c: corr_lookup_onthefly(a, b, c, radius, num_levels),
+        fmap1,
+        fmap2,
+        coords,
+    )
+    return vjp(g)
+
+
+corr_lookup_pallas.defvjp(_fwd, _bwd)

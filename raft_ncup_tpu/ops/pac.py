@@ -1,0 +1,160 @@
+"""Pixel-adaptive convolution (PAC) primitives, functional JAX.
+
+The reference carries NVIDIA's PAC suite with hand-written autograd
+Functions (reference: core/pac_modules.py:90-329). In JAX the einsum
+forward *is* the implementation — autodiff derives the backward — so this
+module is the ``native_impl`` code paths (reference:
+core/pac_modules.py:371-424,440-443,462-467,481-489) re-expressed in
+channel-last layout:
+
+- patches are (B, H, W, k*k, C) stacks of dilated shifted slices
+  (k is small, so k^2 XLA slices fuse cleanly; no im2col materialization
+  beyond what the einsum needs);
+- the adapting kernel is a Gaussian on guidance-feature differences from
+  the window center;
+- transposed conv = zero-stuff by stride, asymmetric pad, stride-1 PAC
+  conv with the spatially transposed weight.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def extract_patches(
+    x: jax.Array,
+    ksize: int,
+    dilation: int = 1,
+    pad_lo: Optional[tuple[int, int]] = None,
+    pad_hi: Optional[tuple[int, int]] = None,
+) -> jax.Array:
+    """Stride-1 sliding windows: (B, H, W, C) -> (B, H', W', k*k, C).
+
+    ``pad_lo``/``pad_hi`` are per-dim (top/left, bottom/right) paddings;
+    default is the 'same' padding (k-1)*d // 2 on both sides.
+    """
+    span = (ksize - 1) * dilation
+    if pad_lo is None:
+        pad_lo = (span // 2, span // 2)
+    if pad_hi is None:
+        pad_hi = (span - span // 2, span - span // 2)
+    x = jnp.pad(
+        x,
+        ((0, 0), (pad_lo[0], pad_hi[0]), (pad_lo[1], pad_hi[1]), (0, 0)),
+    )
+    H_out = x.shape[1] - span
+    W_out = x.shape[2] - span
+    rows = []
+    for i in range(ksize):
+        for j in range(ksize):
+            rows.append(
+                x[:, i * dilation : i * dilation + H_out,
+                  j * dilation : j * dilation + W_out, :]
+            )
+    return jnp.stack(rows, axis=3)
+
+
+def pac_gaussian_kernel(
+    guide: jax.Array,
+    ksize: int,
+    dilation: int = 1,
+    channel_wise: bool = False,
+) -> jax.Array:
+    """Adapting kernel K = exp(-0.5 ||g_i - g_center||^2) over each window
+    (reference: core/pac_modules.py:377-404 native path, gaussian type).
+
+    Returns (B, H, W, k*k) — or (B, H, W, k*k, C) when ``channel_wise``.
+    """
+    patches = extract_patches(guide, ksize, dilation)
+    center = guide[:, :, :, None, :]
+    d2 = (patches - center) ** 2
+    if not channel_wise:
+        d2 = d2.sum(axis=-1)
+    return jnp.exp(-0.5 * d2)
+
+
+def zero_stuff_mask(
+    shape_hw: tuple[int, int], stride: int, dtype=jnp.float32
+) -> jax.Array:
+    """(1, H*s', W*s', 1) indicator of real (non-stuffed) positions in a
+    zero-stuffed grid of an (H, W) input — size (H-1)*s+1 per dim."""
+    h, w = shape_hw
+    oh, ow = (h - 1) * stride + 1, (w - 1) * stride + 1
+    m = jnp.zeros((1, oh, ow, 1), dtype)
+    return m.at[:, ::stride, ::stride, :].set(1.0)
+
+
+def _zero_stuff(x: jax.Array, stride: int) -> jax.Array:
+    """(B, H, W, C) -> (B, (H-1)*s+1, (W-1)*s+1, C) with x at stride
+    positions (the conv-transpose identity-kernel expansion)."""
+    if stride == 1:
+        return x
+    B, H, W, C = x.shape
+    out = jnp.zeros((B, (H - 1) * stride + 1, (W - 1) * stride + 1, C), x.dtype)
+    return out.at[:, ::stride, ::stride, :].set(x)
+
+
+def pacconv2d(
+    x: jax.Array,
+    kernel: jax.Array,
+    weight: jax.Array,
+    bias: Optional[jax.Array] = None,
+    dilation: int = 1,
+    pad_lo: Optional[tuple[int, int]] = None,
+    pad_hi: Optional[tuple[int, int]] = None,
+) -> jax.Array:
+    """Stride-1 PAC convolution (reference: core/pac_modules.py:440-443).
+
+    x: (B, H, W, Cin); kernel: (B, H', W', k*k) from
+    :func:`pac_gaussian_kernel`; weight: (k*k, Cin, Cout).
+    """
+    ksize = int(round(weight.shape[0] ** 0.5))
+    patches = extract_patches(x, ksize, dilation, pad_lo, pad_hi)
+    return _pac_contract(patches, kernel, weight, bias)
+
+
+def _pac_contract(patches, kernel, weight, bias):
+    out = jnp.einsum(
+        "bhwkc,bhwk,kco->bhwo", patches, kernel, weight,
+        preferred_element_type=jnp.float32,
+    )
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def pacconv_transpose2d(
+    x: jax.Array,
+    kernel: jax.Array,
+    weight: jax.Array,
+    bias: Optional[jax.Array] = None,
+    stride: int = 2,
+    padding: int = 0,
+    output_padding: int = 0,
+    dilation: int = 1,
+) -> jax.Array:
+    """Transposed PAC convolution (reference: core/pac_modules.py:462-467):
+    zero-stuff by ``stride``, pad (k-1)*d - p (+output_padding at
+    bottom/right), then stride-1 PAC conv. ``kernel`` is computed from
+    guidance at the OUTPUT resolution; ``weight`` is (k*k, Cin, Cout).
+    """
+    stuffed = _zero_stuff(x, stride)
+    ksize = int(round(weight.shape[0] ** 0.5))
+    pad = (ksize - 1) * dilation - padding
+    return pacconv2d(
+        stuffed, kernel, weight, bias, dilation,
+        pad_lo=(pad, pad),
+        pad_hi=(pad + output_padding, pad + output_padding),
+    )
+
+
+def pacpool2d(
+    x: jax.Array, kernel: jax.Array, ksize: int, dilation: int = 1
+) -> jax.Array:
+    """Kernel-weighted window sum per channel (reference:
+    core/pac_modules.py:481-489, stride 1)."""
+    patches = extract_patches(x, ksize, dilation)
+    return jnp.einsum("bhwkc,bhwk->bhwc", patches, kernel)

@@ -35,13 +35,15 @@ def make_train_step(
 ):
     """Returns ``step(state, batch, rng) -> (state, metrics)``.
 
-    ``batch``: dict with image1/image2 (B, H, W, 3) float32 in [0, 255],
-    flow (B, H, W, 2), valid (B, H, W).
+    ``batch``: dict with image1/image2 (B, H, W, 3) uint8 or float32 in
+    [0, 255] (the loader ships uint8; the cast happens on device), flow
+    (B, H, W, 2), valid (B, H, W).
     """
     freeze_bn = cfg.stage != "chairs"  # reference: train.py:185-186
 
     def loss_fn(params, batch_stats, batch, rng):
-        img1, img2 = batch["image1"], batch["image2"]
+        img1 = batch["image1"].astype(jnp.float32)
+        img2 = batch["image2"].astype(jnp.float32)
         if cfg.add_noise:
             # Gaussian noise with per-step uniform stddev in [0, 5]
             # (reference: train.py:210-213).

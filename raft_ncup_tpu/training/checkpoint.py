@@ -1,0 +1,158 @@
+"""Full-train-state checkpointing with orbax.
+
+The reference saves only ``model.state_dict()`` every 5,000 steps and
+restarts the LR schedule on resume (reference: train.py:229-231; optimizer/
+scheduler state never saved — SURVEY.md §5). Here the whole
+``TrainState`` pytree — params, batch_stats, optimizer moments, step —
+round-trips through orbax, so resume is exact.
+
+Three load paths mirror the reference's semantics:
+
+- :func:`restore` — resume a run from this framework's own checkpoints
+  (the ``--restore_ckpt`` analogue; reference: train.py:179-180);
+- :func:`load_torch` — import a PyTorch reference ``.pth`` into the model
+  variables (strict, the eval path; reference: evaluate.py:257);
+- :func:`load_pretrained_trunk` — warm-start the RAFT trunk of a
+  raft_nc_dbl model from a RAFT checkpoint, ignoring the missing
+  upsampler (reference: core/raft_nc_dbl.py:57-66).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from raft_ncup_tpu.training.state import TrainState
+from raft_ncup_tpu.utils.torch_import import load_torch_checkpoint
+
+
+class CheckpointManager:
+    """Thin orbax CheckpointManager wrapper bound to a run directory."""
+
+    def __init__(self, directory: str, max_to_keep: int = 5):
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, state: TrainState, step: Optional[int] = None) -> None:
+        step = int(state.step) if step is None else int(step)
+        payload = {
+            "step": np.asarray(state.step),
+            "params": state.params,
+            "batch_stats": state.batch_stats,
+            "opt_state": state.opt_state,
+        }
+        self._mgr.save(step, args=ocp.args.StandardSave(payload))
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    @property
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(
+        self, state: TrainState, step: Optional[int] = None
+    ) -> TrainState:
+        """Restore into the structure of ``state`` (which supplies the
+        optimizer transform and pytree shapes)."""
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint found")
+        target = {
+            "step": np.asarray(state.step),
+            "params": state.params,
+            "batch_stats": state.batch_stats,
+            "opt_state": state.opt_state,
+        }
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(target)
+        )
+        return state.replace(
+            step=jax.numpy.asarray(restored["step"]),
+            params=restored["params"],
+            batch_stats=restored["batch_stats"],
+            opt_state=restored["opt_state"],
+        )
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def load_torch(path: str, variables: dict, strict: bool = True) -> dict:
+    """Import a PyTorch ``.pth`` state dict into model variables."""
+    return load_torch_checkpoint(path, variables, strict=strict)
+
+
+def load_pretrained_trunk(path: str, variables: dict) -> dict:
+    """Warm-start the RAFT trunk from a RAFT checkpoint (torch ``.pth`` or
+    an orbax run dir), leaving upsampler params at init.
+
+    Mirrors ``--load_pretrained`` (reference: core/raft_nc_dbl.py:57-66):
+    the source has no upsampler keys, which is fine; source keys that match
+    nothing raise.
+    """
+    if os.path.isdir(path):
+        restored = _restore_variables_only(path)
+        return _merge_trunk(restored, variables)
+    return load_torch_checkpoint(path, variables, strict=True)
+
+
+def _restore_variables_only(directory: str) -> dict:
+    mgr = ocp.CheckpointManager(os.path.abspath(directory))
+    step = mgr.latest_step()
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint in {directory}")
+    restored = mgr.restore(step)
+    mgr.close()
+    out = {"params": restored["params"]}
+    if restored.get("batch_stats"):
+        out["batch_stats"] = restored["batch_stats"]
+    return out
+
+
+def _merge_trunk(source: dict, dest: dict) -> dict:
+    """Leaf-level merge of ``source`` variables into ``dest`` variables.
+
+    Source leaves with no destination are allowed (the RAFT mask head is
+    deleted in raft_nc_dbl — reference: core/raft_nc_dbl.py:68); dest
+    leaves absent from the source stay at init (the NCUP upsampler).
+    But if an entire source component (fnet/cnet/...) matches nothing, or
+    a matching leaf has the wrong shape, raise — a silently unmatched
+    trunk would leave the model at random init while the driver reports a
+    successful warm start."""
+    from flax import traverse_util
+
+    out = {"params": dict(dest["params"])}
+    if "batch_stats" in dest:
+        out["batch_stats"] = dict(dest["batch_stats"])
+    for group in ("params", "batch_stats"):
+        if group not in source or group not in out:
+            continue
+        src_flat = traverse_util.flatten_dict(source[group])
+        dst_flat = dict(traverse_util.flatten_dict(out[group]))
+        matched_components: set = set()
+        for key, val in src_flat.items():
+            if key in dst_flat:
+                if np.shape(dst_flat[key]) != np.shape(val):
+                    raise ValueError(
+                        f"shape mismatch for {group}/{'/'.join(key)}: "
+                        f"{np.shape(val)} vs {np.shape(dst_flat[key])}"
+                    )
+                dst_flat[key] = val
+                matched_components.add(key[0])
+        unmatched = {k[0] for k in src_flat} - matched_components
+        if unmatched:
+            raise ValueError(
+                f"pretrained {group} components matched nothing in the "
+                f"destination model: {sorted(unmatched)}"
+            )
+        out[group] = traverse_util.unflatten_dict(dst_flat)
+    return out

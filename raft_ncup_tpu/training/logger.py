@@ -1,0 +1,108 @@
+"""Training metrics logging: text file + console + TensorBoard.
+
+Covers the reference ``Logger`` (reference: train.py:102-164): running
+means printed every ``sum_freq`` steps, args dumped once at startup,
+train scalars and validation dicts to TensorBoard — without the
+reference's reliance on a global ``args`` and its lazily-created default
+writer (quirks noted in SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Mapping, Optional
+
+
+class Logger:
+    def __init__(
+        self,
+        run_dir: str,
+        config: Any = None,
+        sum_freq: int = 100,
+        use_tensorboard: bool = True,
+    ):
+        self.run_dir = run_dir
+        self.sum_freq = sum_freq
+        os.makedirs(run_dir, exist_ok=True)
+        self._txt = open(os.path.join(run_dir, "log.txt"), "a")
+        self._writer = None
+        if use_tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._writer = SummaryWriter(
+                    log_dir=os.path.join(run_dir, "tb")
+                )
+            except ImportError:
+                pass
+        # Metrics accumulate as-is (possibly device scalars) and are only
+        # converted to host floats when a summary fires, so pushing never
+        # forces a device sync mid-step.
+        self._pending: list[Mapping[str, Any]] = []
+        self._t_last = time.perf_counter()
+        self._steps_last: Optional[int] = None
+        if config is not None:
+            self.write_text(self._config_str(config))
+
+    @staticmethod
+    def _config_str(config: Any) -> str:
+        try:
+            from raft_ncup_tpu.config import config_to_json
+
+            return config_to_json(config)
+        except Exception:
+            return repr(config)
+
+    def write_text(self, text: str) -> None:
+        self._txt.write(text + "\n")
+        self._txt.flush()
+
+    def push(self, step: int, metrics: Mapping[str, Any], lr: Optional[float] = None) -> None:
+        """Accumulate one step's metrics; emit a summary every sum_freq
+        steps (reference: train.py:124-139)."""
+        self._pending.append(metrics)
+        if self._steps_last is None:
+            self._steps_last = step  # first push after start/resume
+        if (step + 1) % self.sum_freq == 0 and self._pending:
+            lr = None if lr is None else float(lr)
+            sums: dict[str, float] = {}
+            for m in self._pending:
+                for k, v in m.items():
+                    sums[k] = sums.get(k, 0.0) + float(v)
+            means = {k: v / len(self._pending) for k, v in sums.items()}
+            now = time.perf_counter()
+            sps = (step + 1 - self._steps_last) / max(now - self._t_last, 1e-9)
+            self._t_last, self._steps_last = now, step + 1
+            parts = [f"[{step + 1:6d}"]
+            if lr is not None:
+                parts.append(f"lr {lr:.2e}")
+            parts.append(f"{sps:5.2f} it/s]")
+            parts += [f"{k} {v:.4f}" for k, v in sorted(means.items())]
+            line = " ".join(parts)
+            print(line, flush=True)
+            self.write_text(line)
+            if self._writer is not None:
+                for k, v in means.items():
+                    self._writer.add_scalar(f"train/{k}", v, step + 1)
+                if lr is not None:
+                    self._writer.add_scalar("train/lr", lr, step + 1)
+                self._writer.add_scalar("train/steps_per_sec", sps, step + 1)
+            self._pending = []
+
+    def write_dict(self, step: int, results: Mapping[str, float]) -> None:
+        """Log a validation-results dict (reference: train.py:151-161)."""
+        line = f"[val @ {step}] " + json.dumps(
+            {k: round(float(v), 5) for k, v in results.items()}
+        )
+        print(line, flush=True)
+        self.write_text(line)
+        if self._writer is not None:
+            for k, v in results.items():
+                self._writer.add_scalar(f"val/{k}", float(v), step)
+
+    def close(self) -> None:
+        self._txt.close()
+        if self._writer is not None:
+            self._writer.close()

@@ -1,0 +1,38 @@
+#!/bin/bash
+# TPU re-make of the reference KITTI fine-tune (reference:
+# train_raft_nc_kitti.sh:13-28): 50k steps, crop 288x960, lr 1e-4,
+# gamma 0.85, wdecay 1e-5.
+set -e
+EXP=raft_nc_kitti_ft
+
+python -u train.py \
+  --name "$EXP" \
+  --model raft_nc_dbl \
+  --load_pretrained models/raft-sintel.pth \
+  --stage kitti \
+  --num_steps 50000 \
+  --batch_size 6 \
+  --lr 0.0001 \
+  --image_size 288 960 \
+  --gamma 0.85 \
+  --wdecay 0.00001 \
+  --optimizer adamw \
+  --scheduler cyclic \
+  --final_upsampling=NConvUpsampler \
+  --final_upsampling_scale=4 \
+  --final_upsampling_use_data_for_guidance=True \
+  --final_upsampling_channels_to_batch=True \
+  --interp_net=NConvUNet \
+  --interp_net_channels_multiplier=2 \
+  --interp_net_num_downsampling=1 \
+  --interp_net_data_pooling="conf_based" \
+  --interp_net_encoder_filter_sz=5 \
+  --interp_net_decoder_filter_sz=3 \
+  --interp_net_out_filter_sz=1 \
+  --interp_net_shared_encoder=True \
+  --interp_net_use_bias=False \
+  --weights_est_net=Simple \
+  --weights_est_net_num_ch="[64, 32]" \
+  --weights_est_net_filter_sz="[3, 3, 1]" \
+  --weights_est_net_dilation="[1, 1, 1]" \
+  "$@"

@@ -1,0 +1,141 @@
+"""Tests for orbax checkpoint/resume, trunk warm-start, and the logger."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_ncup_tpu.config import (
+    ModelConfig,
+    TrainConfig,
+    small_model_config,
+)
+from raft_ncup_tpu.training.checkpoint import (
+    CheckpointManager,
+    load_pretrained_trunk,
+)
+from raft_ncup_tpu.training.logger import Logger
+from raft_ncup_tpu.training.state import create_train_state
+
+SHAPE = (1, 32, 48, 3)
+
+
+def tiny_upsampler_overrides():
+    from raft_ncup_tpu.config import UpsamplerConfig
+
+    return UpsamplerConfig(weights_est_num_ch=(8, 8))
+
+
+@pytest.fixture(scope="module")
+def raft_state():
+    cfg = small_model_config("raft", dataset="chairs")
+    tcfg = TrainConfig(stage="chairs", batch_size=1, image_size=(32, 48), num_steps=10)
+    return create_train_state(jax.random.PRNGKey(0), cfg, tcfg, SHAPE)
+
+
+class TestCheckpointRoundtrip:
+    def test_save_restore_exact(self, tmp_path, raft_state):
+        model, state = raft_state
+        state = state.replace(step=jnp.asarray(7, jnp.int32))
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(state)
+        mgr.wait()
+        assert mgr.latest_step == 7
+
+        # Perturb, then restore into the perturbed structure.
+        wrecked = state.replace(
+            step=jnp.zeros((), jnp.int32),
+            params=jax.tree.map(lambda x: x * 0.0, state.params),
+        )
+        restored = mgr.restore(wrecked)
+        assert int(restored.step) == 7
+        orig = jax.tree.leaves(state.params)
+        back = jax.tree.leaves(restored.params)
+        for a, b in zip(orig, back):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # Optimizer moments restored too.
+        for a, b in zip(
+            jax.tree.leaves(state.opt_state), jax.tree.leaves(restored.opt_state)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        mgr.close()
+
+    def test_max_to_keep(self, tmp_path, raft_state):
+        _, state = raft_state
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+        for s in (1, 2, 3):
+            mgr.save(state, step=s)
+        mgr.wait()
+        assert mgr.latest_step == 3
+        steps = sorted(
+            int(d) for d in os.listdir(tmp_path / "ckpt") if d.isdigit()
+        )
+        assert steps == [2, 3]
+        mgr.close()
+
+    def test_restore_empty_raises(self, tmp_path, raft_state):
+        _, state = raft_state
+        mgr = CheckpointManager(str(tmp_path / "empty"))
+        with pytest.raises(FileNotFoundError):
+            mgr.restore(state)
+        mgr.close()
+
+
+class TestTrunkWarmStart:
+    def test_orbax_raft_into_nc_dbl(self, tmp_path):
+        # Train-state checkpoint of a small RAFT...
+        raft_cfg = small_model_config("raft", dataset="chairs")
+        tcfg = TrainConfig(stage="chairs", batch_size=1, image_size=(32, 48), num_steps=10)
+        _, src_state = create_train_state(
+            jax.random.PRNGKey(1), raft_cfg, tcfg, SHAPE
+        )
+        mgr = CheckpointManager(str(tmp_path / "raft_ckpt"))
+        mgr.save(src_state, step=5)
+        mgr.wait()
+        mgr.close()
+
+        # ...warm-starts the trunk of a small raft_nc_dbl.
+        ncup_cfg = ModelConfig(
+            variant="raft_nc_dbl",
+            small=True,
+            dataset="chairs",
+            upsampler=tiny_upsampler_overrides(),
+        )
+        from raft_ncup_tpu.models.raft import RAFT
+
+        model = RAFT(ncup_cfg)
+        dest = model.init(jax.random.PRNGKey(2), SHAPE)
+        before_up = jax.tree.leaves(dest["params"]["upsampler"])
+
+        merged = load_pretrained_trunk(str(tmp_path / "raft_ckpt"), dest)
+        # Trunk params replaced by source values...
+        src_leaf = jax.tree.leaves(src_state.params["fnet"])[0]
+        dst_leaf = jax.tree.leaves(merged["params"]["fnet"])[0]
+        np.testing.assert_array_equal(np.asarray(src_leaf), np.asarray(dst_leaf))
+        # ...upsampler untouched.
+        after_up = jax.tree.leaves(merged["params"]["upsampler"])
+        for a, b in zip(before_up, after_up):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # Model still runs with merged variables.
+        img = jnp.zeros(SHAPE, jnp.float32)
+        lr_flow, up = model.apply(merged, img, img, iters=2, test_mode=True)
+        assert up.shape == (1, 32, 48, 2)
+
+
+class TestLogger:
+    def test_push_and_val(self, tmp_path, capsys):
+        logger = Logger(
+            str(tmp_path / "run"), config=TrainConfig(), sum_freq=2,
+            use_tensorboard=False,
+        )
+        logger.push(0, {"loss": 2.0, "epe": 4.0}, lr=1e-4)
+        logger.push(1, {"loss": 1.0, "epe": 2.0}, lr=1e-4)  # triggers summary
+        logger.write_dict(2, {"chairs_epe": 3.5})
+        logger.close()
+        text = (tmp_path / "run" / "log.txt").read_text()
+        assert "loss 1.5000" in text and "epe 3.0000" in text
+        assert "chairs_epe" in text
+        out = capsys.readouterr().out
+        assert "loss 1.5000" in out

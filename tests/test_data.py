@@ -1,0 +1,342 @@
+"""Tests for augmentors, dataset index construction, and the loader."""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from raft_ncup_tpu.config import DataConfig
+from raft_ncup_tpu.data import (
+    ColorJitter,
+    FlowAugmentor,
+    FlowLoader,
+    FlyingChairs,
+    KITTI,
+    MixedDataset,
+    MpiSintel,
+    SparseFlowAugmentor,
+    SyntheticFlowDataset,
+    fetch_training_set,
+    resize_sparse_flow_map,
+)
+from raft_ncup_tpu.io import write_flo, write_flow_kitti
+
+
+def rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+# ------------------------------------------------------------------ augment
+
+
+class TestColorJitter:
+    def test_shape_dtype_and_determinism(self):
+        img = rng().integers(0, 255, (40, 30, 3), dtype=np.uint8)
+        out1 = ColorJitter()(img, rng(7))
+        out2 = ColorJitter()(img, rng(7))
+        assert out1.shape == img.shape and out1.dtype == np.uint8
+        np.testing.assert_array_equal(out1, out2)
+
+    def test_identity_factors(self):
+        jitter = ColorJitter(0.0, 0.0, 0.0, 0.0)
+        img = rng().integers(0, 255, (16, 16, 3), dtype=np.uint8)
+        out = jitter(img, rng(1))
+        # All factors exactly 1 / hue shift 0 -> image roundtrips through
+        # float and HSV within rounding.
+        assert np.abs(out.astype(int) - img.astype(int)).max() <= 1
+
+    def test_hue_preserves_value_channel(self):
+        jitter = ColorJitter(0.0, 0.0, 0.0, 0.4)
+        img = rng(2).integers(0, 255, (16, 16, 3), dtype=np.uint8)
+        out = jitter(img, rng(3))
+        # Hue rotation keeps max channel (HSV value) within rounding.
+        np.testing.assert_allclose(
+            out.max(axis=-1).astype(int), img.max(axis=-1).astype(int), atol=2
+        )
+
+
+class TestFlowAugmentor:
+    def test_output_is_crop_size(self):
+        aug = FlowAugmentor(crop_size=(64, 96), min_scale=-0.2, max_scale=0.5)
+        img1 = rng(0).integers(0, 255, (128, 160, 3), dtype=np.uint8)
+        img2 = rng(1).integers(0, 255, (128, 160, 3), dtype=np.uint8)
+        flow = rng(2).normal(size=(128, 160, 2)).astype(np.float32)
+        for seed in range(8):
+            a, b, f = aug(img1, img2, flow, rng(seed))
+            assert a.shape == (64, 96, 3)
+            assert b.shape == (64, 96, 3)
+            assert f.shape == (64, 96, 2)
+            assert a.dtype == np.uint8 and f.dtype == np.float32
+
+    def test_hflip_negates_u(self):
+        aug = FlowAugmentor(
+            crop_size=(32, 32),
+            spatial_aug_prob=0.0,
+            stretch_prob=0.0,
+            asymmetric_color_aug_prob=0.0,
+            eraser_aug_prob=0.0,
+            h_flip_prob=1.0,
+            v_flip_prob=0.0,
+            do_flip=True,
+        )
+        img = np.zeros((32, 32, 3), np.uint8)
+        flow = np.tile(
+            np.array([3.0, 5.0], np.float32), (32, 32, 1)
+        )
+        # Neutralize color jitter by monkey-looking at flow only.
+        _, _, f = aug(img, img, flow, rng(4))
+        np.testing.assert_allclose(f[..., 0], -3.0)
+        np.testing.assert_allclose(f[..., 1], 5.0)
+
+    def test_scale_multiplies_flow(self):
+        aug = FlowAugmentor(
+            crop_size=(32, 32),
+            min_scale=1.0,
+            max_scale=1.0,  # scale = 2.0 exactly
+            spatial_aug_prob=1.0,
+            stretch_prob=0.0,
+            asymmetric_color_aug_prob=0.0,
+            eraser_aug_prob=0.0,
+            do_flip=False,
+        )
+        img = rng(0).integers(0, 255, (64, 64, 3), dtype=np.uint8)
+        flow = np.full((64, 64, 2), 2.0, np.float32)
+        _, _, f = aug(img, img, flow, rng(5))
+        np.testing.assert_allclose(f, 4.0, atol=1e-5)
+
+
+class TestSparse:
+    def test_resize_sparse_scatter(self):
+        flow = np.zeros((8, 8, 2), np.float32)
+        valid = np.zeros((8, 8), np.float32)
+        flow[4, 4] = (1.0, -2.0)
+        valid[4, 4] = 1.0
+        f2, v2 = resize_sparse_flow_map(flow, valid, fx=2.0, fy=2.0)
+        assert f2.shape == (16, 16, 2) and v2.shape == (16, 16)
+        assert v2.sum() == 1
+        np.testing.assert_allclose(f2[8, 8], (2.0, -4.0))
+
+    def test_sparse_augmentor_shapes(self):
+        aug = SparseFlowAugmentor(crop_size=(48, 64))
+        img1 = rng(0).integers(0, 255, (96, 128, 3), dtype=np.uint8)
+        img2 = rng(1).integers(0, 255, (96, 128, 3), dtype=np.uint8)
+        flow = rng(2).normal(size=(96, 128, 2)).astype(np.float32)
+        valid = (rng(3).random((96, 128)) > 0.5).astype(np.float32)
+        for seed in range(6):
+            a, b, f, v = aug(img1, img2, flow, valid, rng(seed))
+            assert a.shape == (48, 64, 3)
+            assert f.shape == (48, 64, 2)
+            assert v.shape == (48, 64)
+            assert set(np.unique(v)).issubset({0, 1})
+
+
+# ----------------------------------------------------------------- fixtures
+
+
+def make_chairs_fixture(root, n=6):
+    root.mkdir(parents=True)
+    g = rng(0)
+    for i in range(1, n + 1):
+        for k in (1, 2):
+            Image.fromarray(
+                g.integers(0, 255, (96, 128, 3), dtype=np.uint8)
+            ).save(root / f"{i:05d}_img{k}.png")
+        write_flo(
+            root / f"{i:05d}_flow.flo",
+            g.normal(size=(96, 128, 2)).astype(np.float32),
+        )
+    split = np.array([1, 1, 2, 1, 2, 1][:n])
+    split_file = root.parent / "chairs_split.txt"
+    np.savetxt(split_file, split, fmt="%d")
+    return split_file
+
+
+def make_sintel_fixture(root, scenes=("alley_1", "market_2"), frames=4):
+    g = rng(1)
+    for dstype in ("clean", "final"):
+        for scene in scenes:
+            d = root / "training" / dstype / scene
+            d.mkdir(parents=True, exist_ok=True)
+            for i in range(frames):
+                Image.fromarray(
+                    g.integers(0, 255, (64, 96, 3), dtype=np.uint8)
+                ).save(d / f"frame_{i:04d}.png")
+    for scene in scenes:
+        d = root / "training" / "flow" / scene
+        d.mkdir(parents=True, exist_ok=True)
+        for i in range(frames - 1):
+            write_flo(
+                d / f"frame_{i:04d}.flo",
+                g.normal(size=(64, 96, 2)).astype(np.float32),
+            )
+
+
+def make_kitti_fixture(root, n=3):
+    d = root / "training"
+    (d / "image_2").mkdir(parents=True)
+    (d / "flow_occ").mkdir(parents=True)
+    g = rng(2)
+    for i in range(n):
+        for suffix in ("10", "11"):
+            Image.fromarray(
+                g.integers(0, 255, (80, 120, 3), dtype=np.uint8)
+            ).save(d / "image_2" / f"{i:06d}_{suffix}.png")
+        write_flow_kitti(
+            d / "flow_occ" / f"{i:06d}_10.png",
+            g.normal(size=(80, 120, 2)).astype(np.float32),
+        )
+
+
+# ----------------------------------------------------------------- datasets
+
+
+class TestDatasets:
+    def test_chairs_split(self, tmp_path):
+        split_file = make_chairs_fixture(tmp_path / "data")
+        train = FlyingChairs(
+            None, split="training", root=str(tmp_path / "data"),
+            split_file=str(split_file),
+        )
+        val = FlyingChairs(
+            None, split="validation", root=str(tmp_path / "data"),
+            split_file=str(split_file),
+        )
+        assert len(train) == 4 and len(val) == 2
+        s = train.sample(0)
+        assert s["image1"].shape == (96, 128, 3)
+        assert s["flow"].shape == (96, 128, 2)
+        assert s["valid"].shape == (96, 128)
+        assert s["valid"].all()  # all synthetic flows are small
+
+    def test_sintel_pairs_per_scene(self, tmp_path):
+        make_sintel_fixture(tmp_path / "Sintel")
+        ds = MpiSintel(None, root=str(tmp_path / "Sintel"), dstype="clean")
+        # 2 scenes x (4 frames - 1) pairs
+        assert len(ds) == 6
+        assert len(ds.flow_list) == 6
+        s = ds.sample(2)
+        assert s["image1"].shape == (64, 96, 3)
+
+    def test_kitti_sparse(self, tmp_path):
+        make_kitti_fixture(tmp_path / "KITTI")
+        ds = KITTI(None, root=str(tmp_path / "KITTI"))
+        assert len(ds) == 3
+        s = ds.sample(1)
+        assert s["valid"].shape == (80, 120)
+
+    def test_mixture_table(self, tmp_path):
+        make_sintel_fixture(tmp_path / "Sintel")
+        clean = MpiSintel(None, root=str(tmp_path / "Sintel"), dstype="clean")
+        final = MpiSintel(None, root=str(tmp_path / "Sintel"), dstype="final")
+        mix = MixedDataset([(clean, 3), (final, 1)])
+        assert len(mix) == 3 * 6 + 6
+        s = mix.sample(0)
+        assert s["image1"].shape == (64, 96, 3)
+
+    def test_fetch_training_set_sintel_stage(self, tmp_path):
+        make_sintel_fixture(tmp_path / "Sintel")
+        make_kitti_fixture(tmp_path / "KITTI")
+        cfg = DataConfig(
+            root_sintel=str(tmp_path / "Sintel"),
+            root_kitti=str(tmp_path / "KITTI"),
+            root_things=str(tmp_path / "nonexistent"),
+            root_hd1k=str(tmp_path / "nonexistent"),
+        )
+        mix = fetch_training_set("sintel", (32, 48), cfg)
+        # 100*6 + 100*6 + 200*3 (things/hd1k empty and dropped)
+        assert len(mix) == 1800
+        s = mix.sample(0, rng(0))
+        assert s["image1"].shape == (32, 48, 3)
+
+
+# ------------------------------------------------------------------- loader
+
+
+class TestLoader:
+    def test_batches_shapes_and_determinism(self):
+        ds = SyntheticFlowDataset((40, 56), length=16, seed=3)
+        loader = FlowLoader(
+            ds, batch_size=4, seed=5, num_workers=2,
+            shard_index=0, num_shards=1,
+        )
+        it = loader.batches()
+        b = next(it)
+        assert b["image1"].shape == (4, 40, 56, 3)
+        assert b["flow"].shape == (4, 40, 56, 2)
+        assert b["valid"].shape == (4, 40, 56)
+        assert b["image1"].dtype == np.uint8  # images ship uint8 to device
+        assert b["flow"].dtype == np.float32
+        it2 = FlowLoader(
+            ds, batch_size=4, seed=5, num_workers=2,
+            shard_index=0, num_shards=1,
+        ).batches()
+        b2 = next(it2)
+        np.testing.assert_array_equal(b["image1"], b2["image1"])
+        it.close()
+        it2.close()
+
+    def test_host_sharding_is_disjoint(self):
+        ds = SyntheticFlowDataset((16, 16), length=12, seed=0)
+        seen = []
+        for shard in (0, 1):
+            loader = FlowLoader(
+                ds, batch_size=2, seed=9, shuffle=True,
+                shard_index=shard, num_shards=2, num_workers=1,
+            )
+            seen.append(np.concatenate([loader._epoch_indices(0)]))
+        assert set(seen[0]).isdisjoint(seen[1])
+        assert len(set(seen[0]) | set(seen[1])) == 12
+
+    def test_one_epoch_length(self):
+        ds = SyntheticFlowDataset((16, 16), length=10, seed=0)
+        loader = FlowLoader(
+            ds, batch_size=3, shard_index=0, num_shards=1, num_workers=1
+        )
+        batches = list(loader.one_epoch())
+        assert len(batches) == 3  # drop_last
+
+    def test_len_matches_one_epoch_on_uneven_shards(self):
+        # 13 samples over 2 shards: shard 0 gets ceil(13/2)=7 -> 7 batches.
+        ds = SyntheticFlowDataset((16, 16), length=13, seed=0)
+        loader = FlowLoader(
+            ds, batch_size=1, shard_index=0, num_shards=2, num_workers=1
+        )
+        assert len(loader) == len(list(loader.one_epoch())) == 7
+
+    def test_empty_dataset_raises(self):
+        ds = SyntheticFlowDataset((16, 16), length=2, seed=0)
+        with pytest.raises(ValueError, match="zero batches"):
+            FlowLoader(ds, batch_size=4, shard_index=0, num_shards=1)
+
+    def test_synthetic_fallback(self, tmp_path):
+        cfg = DataConfig(
+            root_kitti=str(tmp_path / "nope"), synthetic_ok=True
+        )
+        ds = fetch_training_set("kitti", (32, 48), cfg)
+        assert isinstance(ds, SyntheticFlowDataset) and len(ds) > 0
+        cfg_strict = DataConfig(root_kitti=str(tmp_path / "nope"))
+        assert len(fetch_training_set("kitti", (32, 48), cfg_strict)) == 0
+
+    def test_synthetic_pair_consistency(self):
+        # image2 should be approximately image1 warped by flow: check EPE of
+        # zero-flow is worse than the generating flow under photometric loss.
+        ds = SyntheticFlowDataset((64, 64), length=2, seed=1, max_mag=6.0)
+        s = ds.sample(0)
+        import cv2
+
+        h, w = 64, 64
+        xx, yy = np.meshgrid(np.arange(w, dtype=np.float32),
+                             np.arange(h, dtype=np.float32))
+        warped = cv2.remap(
+            s["image1"],
+            xx - s["flow"][..., 0],
+            yy - s["flow"][..., 1],
+            cv2.INTER_LINEAR,
+            borderMode=cv2.BORDER_REFLECT,
+        )
+        err_warp = np.abs(
+            warped.astype(float) - s["image2"].astype(float)
+        ).mean()
+        err_identity = np.abs(
+            s["image1"].astype(float) - s["image2"].astype(float)
+        ).mean()
+        assert err_warp < err_identity * 0.5

@@ -1,0 +1,213 @@
+"""Tests for the CLI bridge, evaluation functions, and the train driver."""
+
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+from PIL import Image
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_ncup_tpu.cli import parse_eval, parse_train
+from raft_ncup_tpu.config import small_model_config, TrainConfig, UpsamplerConfig
+from raft_ncup_tpu.evaluation import (
+    create_kitti_submission,
+    validate_chairs,
+    validate_kitti,
+)
+from raft_ncup_tpu.io import read_flow_kitti, write_flo, write_flow_kitti
+from raft_ncup_tpu.models.raft import RAFT
+
+# The exact flag block every shipped reference script passes
+# (reference: train_raft_nc_things.sh:19-50).
+REFERENCE_SCRIPT_FLAGS = [
+    "--name", "raft_nc_things_ft",
+    "--model", "raft_nc_dbl",
+    "--stage", "things",
+    "--validation", "sintel",
+    "--compressed_ft",
+    "--gpus", "0", "1",
+    "--num_steps", "100000",
+    "--batch_size", "6",
+    "--lr", "0.000125",
+    "--image_size", "400", "720",
+    "--optimizer", "adamW",
+    "--scheduler", "cyclic",
+    "--final_upsampling=NConvUpsampler",
+    "--final_upsampling_scale=4",
+    "--final_upsampling_use_data_for_guidance=True",
+    "--final_upsampling_channels_to_batch=True",
+    "--final_upsampling_use_residuals=False",
+    "--final_upsampling_est_on_high_res=False",
+    "--interp_net=NConvUNet",
+    "--interp_net_channels_multiplier=2",
+    "--interp_net_num_downsampling=1",
+    "--interp_net_data_pooling=conf_based",
+    "--interp_net_encoder_filter_sz=5",
+    "--interp_net_decoder_filter_sz=3",
+    "--interp_net_out_filter_sz=1",
+    "--interp_net_shared_encoder=True",
+    "--interp_net_use_double_conv=False",
+    "--interp_net_use_bias=False",
+    "--weights_est_net=Simple",
+    "--weights_est_net_num_ch=[64, 32]",
+    "--weights_est_net_filter_sz=[3, 3, 1]",
+    "--weights_est_net_dilation=[1, 1, 1]",
+]
+
+
+class TestCli:
+    def test_reference_script_flags_resolve(self):
+        args, model_cfg, train_cfg, data_cfg = parse_train(
+            REFERENCE_SCRIPT_FLAGS
+        )
+        assert model_cfg.variant == "raft_nc_dbl"
+        assert model_cfg.dataset == "things"  # BN off outside sintel
+        ups = model_cfg.upsampler
+        assert ups.kind == "nconv" and ups.scale == 4
+        assert ups.weights_est_num_ch == (64, 32)
+        assert ups.weights_est_filter_sz == (3, 3, 1)
+        assert ups.shared_encoder and not ups.use_bias
+        assert train_cfg.num_steps == 100000
+        assert train_cfg.lr == pytest.approx(0.000125)
+        assert train_cfg.image_size == (400, 720)
+        assert train_cfg.optimizer == "adamw"
+        assert train_cfg.validation == ("sintel",)
+        assert data_cfg.compressed_ft
+
+    def test_eval_parser(self):
+        args, model_cfg, data_cfg = parse_eval(
+            ["--model", "raft_nc_dbl", "--dataset", "sintel",
+             "--restore_ckpt", "x"]
+        )
+        assert model_cfg.dataset == "sintel"  # upsampler BN on for sintel
+        assert args.dataset == "sintel"
+
+    def test_upsampler_bi_overrides(self):
+        _, model_cfg, *_ = parse_train(
+            ["--stage", "chairs", "--model", "raft_nc_dbl", "--upsampler_bi"]
+        )
+        assert model_cfg.upsampler.kind == "bilinear"
+
+
+# ------------------------------------------------------------------ fixtures
+
+
+def make_chairs_fixture(root, n=3, hw=(48, 64)):
+    root.mkdir(parents=True)
+    g = np.random.default_rng(0)
+    for i in range(1, n + 1):
+        for k in (1, 2):
+            Image.fromarray(
+                g.integers(0, 255, (*hw, 3), dtype=np.uint8)
+            ).save(root / f"{i:05d}_img{k}.png")
+        write_flo(
+            root / f"{i:05d}_flow.flo",
+            g.normal(size=(*hw, 2)).astype(np.float32),
+        )
+    split_file = root.parent / "chairs_split.txt"
+    np.savetxt(split_file, np.full(n, 2), fmt="%d")  # all validation
+    return split_file
+
+
+def make_kitti_fixture(root, split, n=2, hw=(48, 64)):
+    d = root / split
+    (d / "image_2").mkdir(parents=True)
+    g = np.random.default_rng(1)
+    for i in range(n):
+        for suffix in ("10", "11"):
+            Image.fromarray(
+                g.integers(0, 255, (*hw, 3), dtype=np.uint8)
+            ).save(d / "image_2" / f"{i:06d}_{suffix}.png")
+    if split == "training":
+        (d / "flow_occ").mkdir(parents=True)
+        for i in range(n):
+            write_flow_kitti(
+                d / "flow_occ" / f"{i:06d}_10.png",
+                g.normal(size=(*hw, 2)).astype(np.float32),
+            )
+
+
+@pytest.fixture(scope="module")
+def tiny_raft():
+    cfg = small_model_config("raft", dataset="chairs")
+    model = RAFT(cfg)
+    variables = model.init(jax.random.PRNGKey(0), (1, 48, 64, 3))
+    return model, variables
+
+
+class TestEvaluation:
+    def test_validate_chairs(self, tmp_path, tiny_raft):
+        from raft_ncup_tpu.config import DataConfig
+
+        split_file = make_chairs_fixture(tmp_path / "chairs")
+        model, variables = tiny_raft
+        cfg = DataConfig(
+            root_chairs=str(tmp_path / "chairs"),
+            chairs_split_file=str(split_file),
+        )
+        out = validate_chairs(model, variables, cfg, iters=2)
+        assert "chairs" in out and np.isfinite(out["chairs"])
+
+    def test_validate_kitti(self, tmp_path, tiny_raft):
+        from raft_ncup_tpu.config import DataConfig
+
+        make_kitti_fixture(tmp_path / "KITTI", "training")
+        model, variables = tiny_raft
+        cfg = DataConfig(root_kitti=str(tmp_path / "KITTI"))
+        out = validate_kitti(model, variables, cfg, iters=2)
+        assert np.isfinite(out["kitti-epe"])
+        assert 0.0 <= out["kitti-f1"] <= 100.0
+
+    def test_kitti_submission_roundtrip(self, tmp_path, tiny_raft):
+        from raft_ncup_tpu.config import DataConfig
+
+        make_kitti_fixture(tmp_path / "KITTI", "testing")
+        model, variables = tiny_raft
+        cfg = DataConfig(root_kitti=str(tmp_path / "KITTI"))
+        out_dir = tmp_path / "subm"
+        create_kitti_submission(
+            model, variables, cfg, iters=2, output_path=str(out_dir)
+        )
+        files = sorted(os.listdir(out_dir))
+        assert files == ["000000_10.png", "000001_10.png"]
+        flow, valid = read_flow_kitti(out_dir / files[0])
+        assert flow.shape == (48, 64, 2)
+        assert valid.all()
+
+
+class TestTrainDriver:
+    def test_train_resume_cycle(self, tmp_path, monkeypatch):
+        import train as train_driver
+
+        monkeypatch.chdir(tmp_path)
+        base = [
+            "--name", "smoke",
+            "--model", "raft",
+            "--small",
+            "--stage", "chairs",
+            "--image_size", "32", "48",
+            "--batch_size", "2",
+            "--iters", "2",
+            "--val_freq", "1000",
+            "--sum_freq", "1",
+            "--synthetic_ok",
+            "--num_workers", "1",
+            "--root_chairs", str(tmp_path / "missing"),
+        ]
+        train_driver.main(base + ["--num_steps", "2"])
+        run_dir = tmp_path / "checkpoints" / "smoke"
+        assert (run_dir / "log.txt").exists()
+        steps = [d for d in os.listdir(run_dir) if d.isdigit()]
+        assert "2" in steps
+
+        # Resume from the saved state and run 2 more steps.
+        train_driver.main(
+            base + ["--num_steps", "4", "--restore_ckpt", str(run_dir)]
+        )
+        steps = {d for d in os.listdir(run_dir) if d.isdigit()}
+        assert "4" in steps
+        log = (run_dir / "log.txt").read_text()
+        assert "restored step 2" in log

@@ -1,0 +1,223 @@
+"""PAC primitive parity tests (vs the PyTorch reference's native_impl
+code paths) and PAC/DJIF head behavior tests."""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REFERENCE = "/root/reference"
+HAVE_REF = os.path.isdir(os.path.join(REFERENCE, "core"))
+if HAVE_REF:
+    sys.path.insert(0, os.path.join(REFERENCE, "core"))
+
+from raft_ncup_tpu.ops.pac import (  # noqa: E402
+    extract_patches,
+    pac_gaussian_kernel,
+    pacconv2d,
+    pacconv_transpose2d,
+)
+
+B, C, H, W = 2, 3, 10, 12
+K = 5
+
+
+def rnp(seed, *shape):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+class TestPrimitives:
+    def test_patches_center_is_input(self):
+        x = jnp.asarray(rnp(0, B, H, W, C))
+        p = extract_patches(x, K)
+        assert p.shape == (B, H, W, K * K, C)
+        np.testing.assert_allclose(p[:, :, :, (K * K) // 2, :], x)
+
+    def test_kernel_center_is_one_and_uniform_guide_all_ones(self):
+        g = jnp.asarray(rnp(1, B, H, W, C))
+        k = pac_gaussian_kernel(g, K)
+        assert k.shape == (B, H, W, K * K)
+        np.testing.assert_allclose(k[:, :, :, (K * K) // 2], 1.0, atol=1e-6)
+        ku = pac_gaussian_kernel(jnp.ones((B, H, W, C)), K)
+        # Interior windows see identical features -> all taps 1; borders
+        # see zero padding -> < 1.
+        np.testing.assert_allclose(ku[:, 2:-2, 2:-2, :], 1.0, atol=1e-6)
+
+    def test_uniform_kernel_equals_plain_conv(self):
+        x = jnp.asarray(rnp(2, B, H, W, C))
+        w = jnp.asarray(rnp(3, K * K, C, 4))
+        ones_kernel = jnp.ones((B, H, W, K * K))
+        out = pacconv2d(x, ones_kernel, w)
+        ref = jax.lax.conv_general_dilated(
+            x,
+            w.reshape(K, K, C, 4),
+            window_strides=(1, 1),
+            padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_gradients_flow(self):
+        x = jnp.asarray(rnp(4, 1, 6, 6, 2))
+        g = jnp.asarray(rnp(5, 1, 12, 12, 3))
+        w = jnp.asarray(rnp(6, K * K, 2, 2))
+
+        def loss(x, g, w):
+            kern = pac_gaussian_kernel(g, K)
+            out = pacconv_transpose2d(
+                x, kern, w, stride=2, padding=2, output_padding=1
+            )
+            return (out**2).sum()
+
+        grads = jax.grad(loss, argnums=(0, 1, 2))(x, g, w)
+        for gr in grads:
+            assert np.isfinite(np.asarray(gr)).all()
+            assert float(jnp.abs(gr).max()) > 0
+
+
+@pytest.mark.reference
+@pytest.mark.skipif(not HAVE_REF, reason="reference repo not mounted")
+class TestTorchParity:
+    def _torch(self):
+        import torch
+
+        import pac_modules as pm
+
+        return torch, pm
+
+    def test_gaussian_kernel_parity(self):
+        torch, pm = self._torch()
+        g = rnp(7, B, C, H, W)
+        ref, _ = pm.packernel2d(
+            torch.from_numpy(g), kernel_size=K, stride=1, padding=2,
+            dilation=1, kernel_type="gaussian", smooth_kernel_type="none",
+            normalize_kernel=False, transposed=False, native_impl=True,
+        )
+        ref = ref.detach().numpy()  # (B, 1, K, K, H, W)
+        ours = np.asarray(
+            pac_gaussian_kernel(jnp.asarray(g.transpose(0, 2, 3, 1)), K)
+        )  # (B, H, W, K*K)
+        ref_r = ref.reshape(B, K * K, H, W).transpose(0, 2, 3, 1)
+        np.testing.assert_allclose(ours, ref_r, rtol=1e-4, atol=1e-5)
+
+    def test_pacconv2d_parity(self):
+        torch, pm = self._torch()
+        x = rnp(8, B, C, H, W)
+        g = rnp(9, B, 2, H, W)
+        wt = rnp(10, 4, C, K, K)  # (Cout, Cin, kh, kw)
+        bias = rnp(11, 4)
+
+        kern_t, _ = pm.packernel2d(
+            torch.from_numpy(g), kernel_size=K, stride=1, padding=2,
+            dilation=1, kernel_type="gaussian", smooth_kernel_type="none",
+            normalize_kernel=False, transposed=False, native_impl=True,
+        )
+        ref = pm.pacconv2d(
+            torch.from_numpy(x), kern_t, torch.from_numpy(wt),
+            torch.from_numpy(bias), stride=1, padding=2, dilation=1,
+            native_impl=True,
+        ).detach().numpy()
+
+        kern = pac_gaussian_kernel(jnp.asarray(g.transpose(0, 2, 3, 1)), K)
+        w_ours = jnp.asarray(wt.transpose(2, 3, 1, 0).reshape(K * K, C, 4))
+        ours = pacconv2d(
+            jnp.asarray(x.transpose(0, 2, 3, 1)), kern, w_ours,
+            jnp.asarray(bias),
+        )
+        np.testing.assert_allclose(
+            np.asarray(ours), ref.transpose(0, 2, 3, 1), rtol=1e-3, atol=1e-4
+        )
+
+    def test_pacconv_transpose2d_parity(self):
+        torch, pm = self._torch()
+        Cin, Cout = 3, 2
+        x = rnp(12, B, Cin, H, W)
+        g_hr = rnp(13, B, 2, H * 2, W * 2)
+        wt = rnp(14, Cin, Cout, K, K)  # torch convT layout (in, out, kh, kw)
+        bias = rnp(15, Cout)
+
+        kern_t, _ = pm.packernel2d(
+            torch.from_numpy(g_hr), kernel_size=K, stride=2, padding=2,
+            output_padding=1, dilation=1, kernel_type="gaussian",
+            smooth_kernel_type="none", normalize_kernel=False,
+            transposed=True, native_impl=True,
+        )
+        ref = pm.pacconv_transpose2d(
+            torch.from_numpy(x), kern_t, torch.from_numpy(wt),
+            torch.from_numpy(bias), stride=2, padding=2, output_padding=1,
+            native_impl=True,
+        ).detach().numpy()
+        assert ref.shape == (B, Cout, H * 2, W * 2)
+
+        kern = pac_gaussian_kernel(
+            jnp.asarray(g_hr.transpose(0, 2, 3, 1)), K
+        )
+        w_ours = jnp.asarray(
+            wt.transpose(2, 3, 0, 1).reshape(K * K, Cin, Cout)
+        )
+        ours = pacconv_transpose2d(
+            jnp.asarray(x.transpose(0, 2, 3, 1)), kern, w_ours,
+            jnp.asarray(bias), stride=2, padding=2, output_padding=1,
+        )
+        np.testing.assert_allclose(
+            np.asarray(ours), ref.transpose(0, 2, 3, 1), rtol=1e-3, atol=1e-4
+        )
+
+
+class TestHeads:
+    def test_pac_joint_upsample_shapes_and_grads(self):
+        from raft_ncup_tpu.nn.pac import PacJointUpsample
+
+        head = PacJointUpsample(factor=4, channels=2, guide_channels=8)
+        x = jnp.asarray(rnp(16, 1, 6, 8, 2))
+        g = jnp.asarray(rnp(17, 1, 24, 32, 8))
+        params = head.init(jax.random.PRNGKey(0), x, g)
+        out = head.apply(params, x, g)
+        assert out.shape == (1, 24, 32, 2)
+        assert np.isfinite(np.asarray(out)).all()
+
+        grads = jax.grad(
+            lambda p: (head.apply(p, x, g) ** 2).sum()
+        )(params)
+        assert all(
+            np.isfinite(np.asarray(le)).all() for le in jax.tree.leaves(grads)
+        )
+
+    def test_djif_shapes(self):
+        from raft_ncup_tpu.nn.pac import DJIF
+
+        head = DJIF(factor=4, channels=2, guide_channels=8)
+        x = jnp.asarray(rnp(18, 1, 6, 8, 2))
+        g = jnp.asarray(rnp(19, 1, 24, 32, 8))
+        params = head.init(jax.random.PRNGKey(0), x, g)
+        out = head.apply(params, x, g)
+        assert out.shape == (1, 24, 32, 2)
+
+    def test_joint_bilateral_constant_field(self):
+        from raft_ncup_tpu.nn.pac import JointBilateral
+
+        head = JointBilateral(factor=2, kernel_size=5)
+        x = jnp.full((1, 6, 8, 2), 3.0)
+        g = jnp.zeros((1, 12, 16, 1))
+        params = head.init(jax.random.PRNGKey(0), x, g)
+        out = head.apply(params, x, g)
+        assert out.shape == (1, 12, 16, 2)
+        # Identity weights + normalized kernel on a constant field must
+        # reproduce the constant.
+        np.testing.assert_allclose(np.asarray(out), 3.0, rtol=1e-5)
+
+    def test_registry_builds_pac_and_djif(self):
+        from raft_ncup_tpu.config import UpsamplerConfig
+        from raft_ncup_tpu.nn.upsampler import build_upsampler
+
+        for kind in ("pac", "djif"):
+            cfg = UpsamplerConfig(kind=kind, scale=4)
+            mod = build_upsampler(cfg, dataset="things")
+            x = jnp.asarray(rnp(20, 1, 4, 6, 2))
+            g = jnp.asarray(rnp(21, 1, 4, 6, 16))
+            params = mod.init(jax.random.PRNGKey(0), x, g)
+            out = mod.apply(params, x, g)
+            assert out.shape == (1, 16, 24, 2)

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Training driver (reference-compatible CLI).
+
+The TPU re-make of the reference trainer (reference: train.py:167-261):
+same stages, loss, schedule, validation cadence and flag names — but the
+step is one jitted SPMD program over a (data, spatial) device mesh, the
+input pipeline is a host-sharded threaded loader, and checkpoints carry
+the full train state (params + optimizer + step) via orbax.
+
+Example (mirrors train_raft_nc_things.sh):
+    python train.py --name raft_nc_things --model raft_nc_dbl \
+        --stage things --num_steps 100000 --batch_size 6 \
+        --lr 0.000125 --image_size 400 720 --final_upsampling=NConvUpsampler
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main(argv=None) -> None:
+    from raft_ncup_tpu.cli import parse_train
+    from raft_ncup_tpu.data import FlowLoader, fetch_training_set
+    from raft_ncup_tpu.evaluation import VALIDATORS
+    from raft_ncup_tpu.parallel.mesh import make_mesh
+    from raft_ncup_tpu.parallel.step import make_train_step
+    from raft_ncup_tpu.training.checkpoint import (
+        CheckpointManager,
+        load_pretrained_trunk,
+    )
+    from raft_ncup_tpu.training.logger import Logger
+    from raft_ncup_tpu.training.optim import build_schedule
+    from raft_ncup_tpu.training.state import create_train_state
+
+    args, model_cfg, train_cfg, data_cfg = parse_train(argv)
+    np.random.seed(train_cfg.seed)  # reference: train.py:345-346
+
+    run_dir = os.path.join(train_cfg.checkpoint_dir, train_cfg.name)
+    logger = Logger(run_dir, config=train_cfg, sum_freq=train_cfg.sum_freq)
+
+    # Device mesh: data-parallel over all chips unless told otherwise. The
+    # per-step global batch must divide evenly over the data axis; when the
+    # size is left implicit, use the largest batch divisor that fits.
+    n_dev = len(jax.devices())
+    if train_cfg.data_parallel:
+        data_par = train_cfg.data_parallel
+        if train_cfg.batch_size % data_par:
+            raise SystemExit(
+                f"--batch_size {train_cfg.batch_size} not divisible by "
+                f"--data_parallel {data_par}"
+            )
+    else:
+        data_par = max(1, n_dev // train_cfg.spatial_parallel)
+        while train_cfg.batch_size % data_par:
+            data_par -= 1
+    use_mesh = data_par * train_cfg.spatial_parallel > 1
+    mesh = (
+        make_mesh(data=data_par, spatial=train_cfg.spatial_parallel)
+        if use_mesh
+        else None
+    )
+    logger.write_text(
+        f"devices={n_dev} mesh=({data_par} data x "
+        f"{train_cfg.spatial_parallel} spatial)"
+    )
+
+    model, state = create_train_state(
+        jax.random.PRNGKey(train_cfg.seed), model_cfg, train_cfg
+    )
+
+    if train_cfg.load_pretrained:
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        merged = load_pretrained_trunk(train_cfg.load_pretrained, variables)
+        state = state.replace(
+            params=merged["params"],
+            batch_stats=merged.get("batch_stats", state.batch_stats),
+        )
+        logger.write_text(f"warm-started trunk from {train_cfg.load_pretrained}")
+
+    ckpt = CheckpointManager(run_dir, max_to_keep=5)
+    if train_cfg.restore_ckpt:
+        restore_mgr = (
+            ckpt
+            if os.path.abspath(train_cfg.restore_ckpt) == os.path.abspath(run_dir)
+            else CheckpointManager(train_cfg.restore_ckpt)
+        )
+        state = restore_mgr.restore(state)
+        logger.write_text(
+            f"restored step {int(state.step)} from {train_cfg.restore_ckpt}"
+        )
+
+    dataset = fetch_training_set(
+        train_cfg.stage, train_cfg.image_size, data_cfg
+    )
+    loader = FlowLoader(
+        dataset,
+        batch_size=train_cfg.batch_size,
+        seed=train_cfg.seed,
+        num_workers=data_cfg.num_workers,
+        prefetch=data_cfg.prefetch,
+    )
+    logger.write_text(
+        f"training with {len(dataset)} pairs "
+        f"({len(loader)} batches/epoch/host)"
+    )
+
+    step_fn = make_train_step(model, train_cfg, mesh=mesh)
+    schedule = build_schedule(train_cfg)
+
+    def run_validation(step: int) -> None:
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        for val_set in train_cfg.validation:
+            results = VALIDATORS[val_set](model, variables, data_cfg)
+            logger.write_dict(step, results)
+
+    total = train_cfg.num_steps
+    # Resume the data stream where the restored run left off: the loader
+    # is deterministic per (seed, epoch, index), so the epoch offset is
+    # derived from the restored step.
+    step_i = int(state.step)
+    batches = loader.batches(start_epoch=step_i // max(len(loader), 1))
+    try:
+        while step_i < total:
+            batch = next(batches)
+            batch.pop("extra_info", None)
+            rng = jax.random.fold_in(
+                jax.random.PRNGKey(train_cfg.seed), step_i
+            )
+            state, metrics = step_fn(
+                state, {k: jnp.asarray(v) for k, v in batch.items()}, rng
+            )
+            step_i += 1  # host-side counter; int(state.step) would sync
+            logger.push(step_i - 1, metrics, lr=schedule(step_i - 1))
+            if step_i % train_cfg.val_freq == 0 or step_i == total:
+                ckpt.save(state)
+                ckpt.wait()
+                run_validation(step_i)
+    finally:
+        batches.close()
+        ckpt.save(state)
+        ckpt.wait()
+        ckpt.close()
+        logger.close()
+    print(f"done: {int(state.step)} steps, checkpoints in {run_dir}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
