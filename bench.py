@@ -15,14 +15,13 @@ progress. It is NOT a PyTorch-reference comparison.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
-from raft_ncup_tpu.config import flagship_config
-from raft_ncup_tpu.models.raft import get_model
+from __graft_entry__ import build_forward
 
 # First recorded value (round 1, single TPU chip, 2026-07-29) is the fixed
 # baseline all later rounds are measured against.
@@ -37,20 +36,14 @@ REPS = 5
 
 def main() -> None:
     platform = jax.devices()[0].platform
-    cfg = flagship_config(dataset="sintel", mixed_precision=(platform == "tpu"))
-    model = get_model(cfg)
-    shape = (BATCH, HEIGHT, WIDTH, 3)
-    variables = model.init(jax.random.PRNGKey(0), shape)
-
-    @jax.jit
-    def forward(variables, image1, image2):
-        return model.apply(
-            variables, image1, image2, iters=ITERS, test_mode=True
-        )
-
-    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
-    img1 = jax.random.uniform(k1, shape, jnp.float32, 0.0, 255.0)
-    img2 = jax.random.uniform(k2, shape, jnp.float32, 0.0, 255.0)
+    corr_impl = os.environ.get("BENCH_CORR_IMPL", "volume")
+    fwd, (variables, img1, img2) = build_forward(
+        shape=(BATCH, HEIGHT, WIDTH, 3),
+        iters=ITERS,
+        mixed_precision=(platform == "tpu"),
+        corr_impl=corr_impl,
+    )
+    forward = jax.jit(fwd)
 
     def run_sync():
         # On the axon TPU tunnel ``block_until_ready`` returns before the
@@ -73,7 +66,7 @@ def main() -> None:
         json.dumps(
             {
                 "metric": f"raft_nc_dbl frame-pairs/sec/chip @ {ITERS} iters "
-                f"{HEIGHT}x{WIDTH} ({platform})",
+                f"{HEIGHT}x{WIDTH} ({platform}, corr={corr_impl})",
                 "value": round(pairs_per_sec, 3),
                 "unit": "pairs/s",
                 "vs_baseline": round(vs, 3),

@@ -147,6 +147,9 @@ def add_train_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--data_parallel", type=int, default=None,
                         help="data-parallel mesh size (default: all devices)")
     parser.add_argument("--spatial_parallel", type=int, default=1)
+    parser.add_argument("--profile_steps", type=int, default=0,
+                        help="capture a jax.profiler device trace of this "
+                        "many early steps into <run_dir>/profile")
 
 
 def model_config_from_args(

@@ -1,4 +1,5 @@
 from raft_ncup_tpu.io.flow_io import (
+    read_disp_kitti,
     read_flo,
     read_flow_kitti,
     read_gen,
@@ -15,6 +16,7 @@ __all__ = [
     "read_pfm",
     "write_pfm",
     "read_flow_kitti",
+    "read_disp_kitti",
     "write_flow_kitti",
     "read_image",
     "read_gen",

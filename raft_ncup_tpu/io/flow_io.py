@@ -134,6 +134,21 @@ def read_flow_kitti(
     return flow, valid
 
 
+def read_disp_kitti(
+    path: Union[str, os.PathLike]
+) -> tuple[np.ndarray, np.ndarray]:
+    """Read a KITTI 16-bit disparity png as pseudo-flow
+    ((H, W, 2) with u = -disparity, v = 0) plus validity
+    (reference: core/utils/frame_utils.py:109-113)."""
+    raw = cv2.imread(str(path), cv2.IMREAD_ANYDEPTH)
+    if raw is None:
+        raise FileNotFoundError(f"cannot read {path}")
+    disp = raw.astype(np.float32) / 256.0
+    valid = disp > 0.0
+    flow = np.stack([-disp, np.zeros_like(disp)], axis=-1)
+    return flow, valid
+
+
 def write_flow_kitti(path: Union[str, os.PathLike], flow: np.ndarray) -> None:
     """Write (H, W, 2) flow as KITTI 16-bit png (all pixels marked valid)."""
     flow = np.asarray(flow, dtype=np.float64)

@@ -50,8 +50,10 @@ def _lookup_kernel(coords_ref, vol_ref, out_ref, *, radius, pad, level):
 
     coords_ref: (Q, 2) float32 — full-res query centers (x, y).
     vol_ref:    (Q, Hp, Wp) float32 — per-query padded volume slab.
-    out_ref:    (Q, K*K) float32 — tap values, x-major (reference tap
-                order: core/corr.py:31-37).
+    out_ref:    (Q, K, K) float32 — window values in natural (y, x) order;
+                the caller transposes to the reference's x-major tap order
+                (core/corr.py:31-37). Mosaic cannot reshape/transpose the
+                9x9 tile in-kernel.
     """
     K = 2 * radius + 1
     Hp, Wp = vol_ref.shape[1], vol_ref.shape[2]
@@ -66,17 +68,24 @@ def _lookup_kernel(coords_ref, vol_ref, out_ref, *, radius, pad, level):
         fy = cy - y0
         ix = jnp.clip(x0.astype(jnp.int32) - radius + pad, 0, Wp - (K + 1))
         iy = jnp.clip(y0.astype(jnp.int32) - radius + pad, 0, Hp - (K + 1))
-        patch = pl.load(
-            vol_ref, (q, pl.ds(iy, K + 1), pl.ds(ix, K + 1))
-        )  # (K+1, K+1) rows = y, cols = x
+        # Mosaic allows dynamic-start slicing on the sublane dim but not
+        # the lane (minor) dim, and dynamic rotates only on the lane dim:
+        # slice rows dynamically, rotate columns so the window starts at
+        # lane 0, then static-slice. The clamp above keeps
+        # [iy, iy+K] x [ix, ix+K] in bounds, so the rotation never wraps
+        # real data into the window.
+        rows = vol_ref[q, pl.ds(iy, K + 1), :]  # (K+1, Wp)
+        # pltpu.roll requires a non-negative shift; left-rotate by ix ==
+        # right-rotate by Wp - ix (ix == 0 must stay 0, not Wp).
+        rows = pltpu.roll(rows, jnp.where(ix == 0, 0, Wp - ix), 1)
+        patch = rows[:, : K + 1]  # rows = y, cols = x
         win = (
             (1 - fy) * (1 - fx) * patch[:K, :K]
             + (1 - fy) * fx * patch[:K, 1:]
             + fy * (1 - fx) * patch[1:, :K]
             + fy * fx * patch[1:, 1:]
         )
-        # win[y_tap, x_tap] -> channel order x-major (i * K + j with i = x).
-        out_ref[q, :] = win.T.reshape(K * K)
+        out_ref[q] = win
         return 0
 
     jax.lax.fori_loop(0, out_ref.shape[0], body, 0)
@@ -111,11 +120,12 @@ def _lookup_one_level(
             pl.BlockSpec((qblk, 2), lambda i: (i, 0)),
             pl.BlockSpec((qblk, Hp, Wp), lambda i: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((qblk, K * K), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((N + n_pad, K * K), jnp.float32),
+        out_specs=pl.BlockSpec((qblk, K, K), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((N + n_pad, K, K), jnp.float32),
         interpret=interpret,
     )(coords.astype(jnp.float32), volp.astype(jnp.float32))
-    return out[:N]
+    # (N, K_y, K_x) -> x-major taps (reference order).
+    return out[:N].transpose(0, 2, 1).reshape(N, K * K)
 
 
 def _forward(

@@ -1,0 +1,93 @@
+"""Profiling and throughput measurement.
+
+The reference ships no profiler hooks or timers (SURVEY.md §5 "tracing").
+Here: a ``jax.profiler`` trace context for capturing device traces viewable
+in TensorBoard/Perfetto, and a wall-clock throughput meter for the
+north-star metric (frame-pairs/sec/chip).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Iterator, Optional
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture a device trace into ``log_dir`` (no-op when None).
+
+    View with TensorBoard's profile plugin or Perfetto.
+    """
+    if log_dir is None:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Rolling step-time/throughput meter.
+
+    ``items_per_step`` is the unit count per step (e.g. frame pairs in the
+    global batch); rates are reported per chip.
+    """
+
+    def __init__(self, items_per_step: float, window: int = 50):
+        self.items_per_step = items_per_step
+        self.window = window
+        self._times: list[float] = []
+        self._last: Optional[float] = None
+        self._chips = max(1, len(jax.devices()))
+
+    def tick(self) -> None:
+        now = time.perf_counter()
+        if self._last is not None:
+            self._times.append(now - self._last)
+            if len(self._times) > self.window:
+                self._times.pop(0)
+        self._last = now
+
+    @property
+    def step_time(self) -> float:
+        return float(np.median(self._times)) if self._times else float("nan")
+
+    @property
+    def items_per_sec_per_chip(self) -> float:
+        st = self.step_time
+        if not np.isfinite(st) or st <= 0:
+            return float("nan")
+        return self.items_per_step / st / self._chips
+
+    def summary(self) -> dict:
+        return {
+            "step_time_s": self.step_time,
+            "items_per_sec_per_chip": self.items_per_sec_per_chip,
+        }
+
+
+def measure_throughput(
+    fn: Callable[[], object],
+    warmup: int = 2,
+    reps: int = 5,
+    sync: Optional[Callable[[object], None]] = None,
+) -> float:
+    """Time ``fn`` (one unit of work) and return calls/sec.
+
+    ``sync`` receives the output and must force completion (e.g. pull one
+    scalar to host); defaults to ``jax.block_until_ready``.
+    """
+    sync = sync or (lambda out: jax.block_until_ready(out))
+    for _ in range(warmup):
+        sync(fn())
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    sync(out)
+    return reps / (time.perf_counter() - t0)

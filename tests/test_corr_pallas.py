@@ -1,0 +1,111 @@
+"""Equivalence tests for the Pallas corr-lookup kernel (interpret mode on
+CPU; the same kernel compiles for TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_ncup_tpu.ops.corr import build_corr_pyramid, corr_lookup
+from raft_ncup_tpu.ops.corr_pallas import corr_lookup_pallas
+from raft_ncup_tpu.ops.geometry import coords_grid
+
+B, H, W, C = 2, 8, 12, 16
+RADIUS = 3
+LEVELS = 3  # deepest level is 2x3 — exercises tiny-volume handling
+
+
+def setup():
+    g = np.random.default_rng(0)
+    fmap1 = jnp.asarray(g.normal(size=(B, H, W, C)), jnp.float32)
+    fmap2 = jnp.asarray(g.normal(size=(B, H, W, C)), jnp.float32)
+    return fmap1, fmap2
+
+
+class TestPallasLookup:
+    def test_matches_volume_path_on_grid(self):
+        fmap1, fmap2 = setup()
+        coords = coords_grid(B, H, W)
+        ref = corr_lookup(
+            build_corr_pyramid(fmap1, fmap2, LEVELS), coords, RADIUS
+        )
+        out = corr_lookup_pallas(
+            fmap1, fmap2, coords, RADIUS, LEVELS, True
+        )
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4
+        )
+
+    def test_matches_volume_path_fractional_and_oob(self):
+        fmap1, fmap2 = setup()
+        g = np.random.default_rng(1)
+        # Fractional offsets plus large displacements that push whole
+        # windows out of bounds in every direction.
+        coords = coords_grid(B, H, W) + jnp.asarray(
+            g.uniform(-1.5 * max(H, W), 1.5 * max(H, W), (B, H, W, 2)),
+            jnp.float32,
+        ) * jnp.asarray(g.random((B, H, W, 2)) < 0.3, jnp.float32) + jnp.asarray(
+            g.uniform(-0.99, 0.99, (B, H, W, 2)), jnp.float32
+        )
+        ref = corr_lookup(
+            build_corr_pyramid(fmap1, fmap2, LEVELS), coords, RADIUS
+        )
+        out = corr_lookup_pallas(
+            fmap1, fmap2, coords, RADIUS, LEVELS, True
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4
+        )
+
+    def test_gradients_match_xla_path(self):
+        fmap1, fmap2 = setup()
+        coords = coords_grid(B, H, W) + 0.3
+
+        def loss_pallas(f1, f2, c):
+            return (
+                corr_lookup_pallas(f1, f2, c, RADIUS, LEVELS, True) ** 2
+            ).sum()
+
+        def loss_ref(f1, f2, c):
+            pyr = build_corr_pyramid(f1, f2, LEVELS)
+            return (corr_lookup(pyr, c, RADIUS) ** 2).sum()
+
+        gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(fmap1, fmap2, coords)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(fmap1, fmap2, coords)
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3
+            )
+
+    def test_model_runs_with_pallas_impl(self):
+        from raft_ncup_tpu.config import small_model_config
+        from raft_ncup_tpu.models.raft import RAFT
+
+        cfg = small_model_config(
+            "raft", dataset="chairs", corr_impl="pallas"
+        )
+        model = RAFT(cfg)
+        # interpret mode is needed on CPU; patch the model's corr_fn via
+        # env-free route: call apply under interpret by monkeypatching.
+        import raft_ncup_tpu.models.raft as raft_mod
+
+        orig = raft_mod.__dict__.get("corr_lookup_pallas")
+        shape = (1, 32, 48, 3)
+        variables = model.init(jax.random.PRNGKey(0), shape)
+        import functools
+
+        import raft_ncup_tpu.ops.corr_pallas as cp
+
+        patched = functools.partial(cp.corr_lookup_pallas, interpret=True)
+        try:
+            cp_orig = cp.corr_lookup_pallas
+            # The model imports lazily from ops.corr_pallas, so patching the
+            # module attribute is sufficient.
+            cp.corr_lookup_pallas = patched
+            img = jnp.zeros(shape, jnp.float32)
+            lr, up = model.apply(variables, img, img, iters=2, test_mode=True)
+            assert up.shape == (1, 32, 48, 2)
+            assert np.isfinite(np.asarray(up)).all()
+        finally:
+            cp.corr_lookup_pallas = cp_orig

@@ -127,9 +127,15 @@ def main(argv=None) -> None:
     # is deterministic per (seed, epoch, index), so the epoch offset is
     # derived from the restored step.
     step_i = int(state.step)
+    start_step = step_i
     batches = loader.batches(start_epoch=step_i // max(len(loader), 1))
+    profiling = False
     try:
         while step_i < total:
+            if args.profile_steps and step_i == start_step + 1:
+                # Skip the compile step, then trace a few hot steps.
+                jax.profiler.start_trace(os.path.join(run_dir, "profile"))
+                profiling = True
             batch = next(batches)
             batch.pop("extra_info", None)
             rng = jax.random.fold_in(
@@ -139,12 +145,21 @@ def main(argv=None) -> None:
                 state, {k: jnp.asarray(v) for k, v in batch.items()}, rng
             )
             step_i += 1  # host-side counter; int(state.step) would sync
+            if profiling and step_i >= start_step + 1 + args.profile_steps:
+                jax.block_until_ready(metrics["loss"])
+                jax.profiler.stop_trace()
+                profiling = False
+                logger.write_text(
+                    f"profile trace written to {run_dir}/profile"
+                )
             logger.push(step_i - 1, metrics, lr=schedule(step_i - 1))
             if step_i % train_cfg.val_freq == 0 or step_i == total:
                 ckpt.save(state)
                 ckpt.wait()
                 run_validation(step_i)
     finally:
+        if profiling:
+            jax.profiler.stop_trace()
         batches.close()
         ckpt.save(state)
         ckpt.wait()
