@@ -16,12 +16,12 @@ from __future__ import annotations
 
 import json
 import os
-import time
 
 import jax
 import numpy as np
 
 from __graft_entry__ import build_forward
+from raft_ncup_tpu.utils.profiling import measure_throughput
 
 # First recorded value (round 1, single TPU chip, 2026-07-29) is the fixed
 # baseline all later rounds are measured against.
@@ -45,22 +45,16 @@ def main() -> None:
     )
     forward = jax.jit(fwd)
 
-    def run_sync():
-        # On the axon TPU tunnel ``block_until_ready`` returns before the
-        # computation finishes; pulling a scalar to host is the only honest
-        # synchronization point.
-        _, flow_up = forward(variables, img1, img2)
-        return np.asarray(flow_up[0, 0, 0, 0])
-
-    for _ in range(WARMUP):
-        run_sync()
-
-    t0 = time.perf_counter()
-    for _ in range(REPS):
-        run_sync()
-    dt = time.perf_counter() - t0
-
-    pairs_per_sec = BATCH * REPS / dt
+    # On the axon TPU tunnel ``block_until_ready`` returns before the
+    # computation finishes; pulling a scalar to host is the only honest
+    # synchronization point.
+    rate = measure_throughput(
+        lambda: forward(variables, img1, img2),
+        warmup=WARMUP,
+        reps=REPS,
+        sync=lambda out: np.asarray(out[1][0, 0, 0, 0]),
+    )
+    pairs_per_sec = BATCH * rate
     vs = pairs_per_sec / BASELINE_PAIRS_PER_SEC if BASELINE_PAIRS_PER_SEC else 0.0
     print(
         json.dumps(

@@ -1,4 +1,13 @@
-from raft_ncup_tpu.parallel.mesh import make_mesh  # noqa: F401
+from raft_ncup_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    make_mesh,
+    replicated,
+)
+from raft_ncup_tpu.parallel.multihost import (  # noqa: F401
+    global_batch,
+    initialize_distributed,
+    is_multihost,
+)
 from raft_ncup_tpu.parallel.step import (  # noqa: F401
     make_eval_step,
     make_train_step,

@@ -1,0 +1,80 @@
+"""Multi-host (pod) support: process initialization + global batch
+assembly.
+
+The reference's entire distributed story is single-process
+``nn.DataParallel`` (reference: train.py:169-175; SURVEY.md §2 C21). Here
+the same jitted SPMD step runs unchanged on a pod: every host runs the
+same program, ``jax.distributed.initialize`` wires the processes into one
+runtime, the mesh spans all chips, gradient psums ride ICI within a slice
+and DCN between them (XLA routes collectives by mesh topology), and each
+host feeds its disjoint input shard (FlowLoader already shards by
+``jax.process_index()``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize the multi-process JAX runtime (no-op when single-process
+    or when the TPU pod environment provides the coordination config).
+
+    On Cloud TPU pods, ``jax.distributed.initialize()`` reads everything
+    from the environment; explicit args support other clusters.
+    """
+    if num_processes == 1:
+        return
+    explicit = coordinator_address is not None or process_id is not None
+    try:
+        jax.distributed.initialize(
+            coordinator_address, num_processes, process_id
+        )
+    except (RuntimeError, ValueError) as e:
+        # With explicit coordination args, a failed init must not fall
+        # back to independent single-process runs silently (every host
+        # would train its own full copy into the same run dir).
+        if explicit:
+            raise
+        # No coordination config: single-process run. Log loudly rather
+        # than swallowing, so a misconfigured pod is visible in the logs.
+        if "already initialized" not in str(e).lower():
+            print(f"jax.distributed.initialize skipped: {e}")
+
+
+def global_batch(batch: dict, mesh: Mesh, shardings: dict) -> dict:
+    """Assemble per-host local batches into global sharded arrays.
+
+    Each host passes its local slice (the FlowLoader shard); the result is
+    a dict of global ``jax.Array`` whose shards live where the mesh puts
+    them — the multi-host replacement for passing host-local numpy straight
+    into jit (which only works single-process).
+    """
+    out = {}
+    for key, value in batch.items():
+        sharding = shardings.get(key)
+        if sharding is None:
+            out[key] = value
+            continue
+        out[key] = jax.make_array_from_process_local_data(
+            sharding, np.asarray(value)
+        )
+    return out
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def replicated_hosts_sharding(mesh: Mesh) -> NamedSharding:
+    from jax.sharding import PartitionSpec as P
+
+    return NamedSharding(mesh, P())

@@ -13,7 +13,6 @@ import time
 from typing import Callable, Iterator, Optional
 
 import jax
-import numpy as np
 
 
 @contextlib.contextmanager
@@ -30,46 +29,6 @@ def trace(log_dir: Optional[str]) -> Iterator[None]:
         yield
     finally:
         jax.profiler.stop_trace()
-
-
-class StepTimer:
-    """Rolling step-time/throughput meter.
-
-    ``items_per_step`` is the unit count per step (e.g. frame pairs in the
-    global batch); rates are reported per chip.
-    """
-
-    def __init__(self, items_per_step: float, window: int = 50):
-        self.items_per_step = items_per_step
-        self.window = window
-        self._times: list[float] = []
-        self._last: Optional[float] = None
-        self._chips = max(1, len(jax.devices()))
-
-    def tick(self) -> None:
-        now = time.perf_counter()
-        if self._last is not None:
-            self._times.append(now - self._last)
-            if len(self._times) > self.window:
-                self._times.pop(0)
-        self._last = now
-
-    @property
-    def step_time(self) -> float:
-        return float(np.median(self._times)) if self._times else float("nan")
-
-    @property
-    def items_per_sec_per_chip(self) -> float:
-        st = self.step_time
-        if not np.isfinite(st) or st <= 0:
-            return float("nan")
-        return self.items_per_step / st / self._chips
-
-    def summary(self) -> dict:
-        return {
-            "step_time_s": self.step_time,
-            "items_per_sec_per_chip": self.items_per_sec_per_chip,
-        }
 
 
 def measure_throughput(

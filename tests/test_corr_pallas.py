@@ -86,11 +86,6 @@ class TestPallasLookup:
             "raft", dataset="chairs", corr_impl="pallas"
         )
         model = RAFT(cfg)
-        # interpret mode is needed on CPU; patch the model's corr_fn via
-        # env-free route: call apply under interpret by monkeypatching.
-        import raft_ncup_tpu.models.raft as raft_mod
-
-        orig = raft_mod.__dict__.get("corr_lookup_pallas")
         shape = (1, 32, 48, 3)
         variables = model.init(jax.random.PRNGKey(0), shape)
         import functools

@@ -15,6 +15,7 @@ Example (mirrors train_raft_nc_things.sh):
 
 from __future__ import annotations
 
+import contextlib
 import os
 import sys
 
@@ -27,7 +28,12 @@ def main(argv=None) -> None:
     from raft_ncup_tpu.cli import parse_train
     from raft_ncup_tpu.data import FlowLoader, fetch_training_set
     from raft_ncup_tpu.evaluation import VALIDATORS
-    from raft_ncup_tpu.parallel.mesh import make_mesh
+    from raft_ncup_tpu.parallel.mesh import batch_sharding, make_mesh
+    from raft_ncup_tpu.parallel.multihost import (
+        global_batch,
+        initialize_distributed,
+        is_multihost,
+    )
     from raft_ncup_tpu.parallel.step import make_train_step
     from raft_ncup_tpu.training.checkpoint import (
         CheckpointManager,
@@ -38,6 +44,7 @@ def main(argv=None) -> None:
     from raft_ncup_tpu.training.state import create_train_state
 
     args, model_cfg, train_cfg, data_cfg = parse_train(argv)
+    initialize_distributed()  # no-op off-pod; wires processes on a pod
     np.random.seed(train_cfg.seed)  # reference: train.py:345-346
 
     run_dir = os.path.join(train_cfg.checkpoint_dir, train_cfg.name)
@@ -45,8 +52,12 @@ def main(argv=None) -> None:
 
     # Device mesh: data-parallel over all chips unless told otherwise. The
     # per-step global batch must divide evenly over the data axis; when the
-    # size is left implicit, use the largest batch divisor that fits.
+    # size is left implicit single-host, use the largest batch divisor that
+    # fits. Multi-host, every host's chips must be in the mesh (a host with
+    # no addressable mesh devices cannot feed its batch shard), so the mesh
+    # always spans all devices and the batch must divide it.
     n_dev = len(jax.devices())
+    multihost = is_multihost()
     if train_cfg.data_parallel:
         data_par = train_cfg.data_parallel
         if train_cfg.batch_size % data_par:
@@ -54,10 +65,22 @@ def main(argv=None) -> None:
                 f"--batch_size {train_cfg.batch_size} not divisible by "
                 f"--data_parallel {data_par}"
             )
+        if multihost and data_par * train_cfg.spatial_parallel != n_dev:
+            raise SystemExit(
+                f"multi-host mesh must span all {n_dev} devices, got "
+                f"{data_par} x {train_cfg.spatial_parallel}"
+            )
     else:
         data_par = max(1, n_dev // train_cfg.spatial_parallel)
-        while train_cfg.batch_size % data_par:
-            data_par -= 1
+        if multihost:
+            if train_cfg.batch_size % data_par:
+                raise SystemExit(
+                    f"--batch_size {train_cfg.batch_size} must be divisible "
+                    f"by the {data_par}-way data axis on a multi-host mesh"
+                )
+        else:
+            while train_cfg.batch_size % data_par:
+                data_par -= 1
     use_mesh = data_par * train_cfg.spatial_parallel > 1
     mesh = (
         make_mesh(data=data_par, spatial=train_cfg.spatial_parallel)
@@ -99,9 +122,17 @@ def main(argv=None) -> None:
     dataset = fetch_training_set(
         train_cfg.stage, train_cfg.image_size, data_cfg
     )
+    # --batch_size is the GLOBAL batch (reference semantics); each host
+    # loads its slice.
+    n_proc = jax.process_count()
+    if train_cfg.batch_size % n_proc:
+        raise SystemExit(
+            f"--batch_size {train_cfg.batch_size} not divisible by "
+            f"{n_proc} hosts"
+        )
     loader = FlowLoader(
         dataset,
-        batch_size=train_cfg.batch_size,
+        batch_size=train_cfg.batch_size // n_proc,
         seed=train_cfg.seed,
         num_workers=data_cfg.num_workers,
         prefetch=data_cfg.prefetch,
@@ -113,6 +144,9 @@ def main(argv=None) -> None:
 
     step_fn = make_train_step(model, train_cfg, mesh=mesh)
     schedule = build_schedule(train_cfg)
+    shardings = (
+        batch_sharding(mesh) if (mesh is not None and multihost) else None
+    )
 
     def run_validation(step: int) -> None:
         variables = {"params": state.params}
@@ -130,24 +164,32 @@ def main(argv=None) -> None:
     start_step = step_i
     batches = loader.batches(start_epoch=step_i // max(len(loader), 1))
     profiling = False
+    profile_scope = contextlib.ExitStack()
     try:
         while step_i < total:
             if args.profile_steps and step_i == start_step + 1:
                 # Skip the compile step, then trace a few hot steps.
-                jax.profiler.start_trace(os.path.join(run_dir, "profile"))
+                from raft_ncup_tpu.utils.profiling import trace
+
+                profile_scope.enter_context(
+                    trace(os.path.join(run_dir, "profile"))
+                )
                 profiling = True
             batch = next(batches)
             batch.pop("extra_info", None)
             rng = jax.random.fold_in(
                 jax.random.PRNGKey(train_cfg.seed), step_i
             )
-            state, metrics = step_fn(
-                state, {k: jnp.asarray(v) for k, v in batch.items()}, rng
-            )
+            if shardings is not None:
+                # Host-local shards -> one global sharded array per key.
+                device_batch = global_batch(batch, mesh, shardings)
+            else:
+                device_batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            state, metrics = step_fn(state, device_batch, rng)
             step_i += 1  # host-side counter; int(state.step) would sync
             if profiling and step_i >= start_step + 1 + args.profile_steps:
                 jax.block_until_ready(metrics["loss"])
-                jax.profiler.stop_trace()
+                profile_scope.close()
                 profiling = False
                 logger.write_text(
                     f"profile trace written to {run_dir}/profile"
@@ -158,8 +200,7 @@ def main(argv=None) -> None:
                 ckpt.wait()
                 run_validation(step_i)
     finally:
-        if profiling:
-            jax.profiler.stop_trace()
+        profile_scope.close()
         batches.close()
         ckpt.save(state)
         ckpt.wait()
