@@ -161,6 +161,48 @@ class TestEvaluation:
         assert np.isfinite(out["kitti-epe"])
         assert 0.0 <= out["kitti-f1"] <= 100.0
 
+    def test_validate_sintel_and_submission(self, tmp_path, tiny_raft):
+        from raft_ncup_tpu.config import DataConfig
+        from raft_ncup_tpu.evaluation import (
+            create_sintel_submission,
+            validate_sintel,
+        )
+        from raft_ncup_tpu.io import read_flo
+
+        # training split (clean+final+flow) and test split (images only)
+        g = np.random.default_rng(5)
+        for split, dstypes in (("training", ("clean", "final")),
+                               ("test", ("clean", "final"))):
+            for dstype in dstypes:
+                d = tmp_path / "Sintel" / split / dstype / "scene_x"
+                d.mkdir(parents=True, exist_ok=True)
+                for i in range(3):
+                    Image.fromarray(
+                        g.integers(0, 255, (48, 64, 3), dtype=np.uint8)
+                    ).save(d / f"frame_{i:04d}.png")
+        fd = tmp_path / "Sintel" / "training" / "flow" / "scene_x"
+        fd.mkdir(parents=True)
+        for i in range(2):
+            write_flo(
+                fd / f"frame_{i:04d}.flo",
+                g.normal(size=(48, 64, 2)).astype(np.float32),
+            )
+
+        model, variables = tiny_raft
+        cfg = DataConfig(root_sintel=str(tmp_path / "Sintel"))
+        out = validate_sintel(model, variables, cfg, iters=2)
+        assert np.isfinite(out["clean"]) and np.isfinite(out["final"])
+        assert 0.0 <= out["clean_1px"] <= 1.0
+
+        sub = tmp_path / "sub"
+        create_sintel_submission(
+            model, variables, cfg, iters=2, warm_start=True,
+            output_path=str(sub),
+        )
+        flo = sub / "clean" / "scene_x" / "frame0001.flo"
+        assert flo.exists()
+        assert read_flo(flo).shape == (48, 64, 2)
+
     def test_kitti_submission_roundtrip(self, tmp_path, tiny_raft):
         from raft_ncup_tpu.config import DataConfig
 
