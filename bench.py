@@ -6,40 +6,77 @@ Prints ONE JSON line:
 Metric: frame-pairs/sec/chip for raft_nc_dbl (NCUP) test-mode inference at
 12 GRU iterations, 368x768 (the Sintel fine-tune crop,
 reference: train_raft_nc_sintel.sh:14). The reference records no
-throughput anywhere (BASELINE.md), so ``vs_baseline`` is the ratio to
-BASELINE_PAIRS_PER_SEC below — this framework's own first recorded
-round-1 number on a single TPU chip, fixed so later rounds show relative
-progress. It is NOT a PyTorch-reference comparison.
+throughput anywhere (BASELINE.md), so ``vs_baseline`` compares against
+this framework's own recorded baselines in ``docs/perf_baseline.json``
+(keyed by platform+shape+impl); when no baseline exists for the platform
+the run is the first recording and ``vs_baseline`` is 1.0.
+
+Robustness (round-1 postmortem: the axon TPU backend failed to init and
+the bench crashed with a traceback, recording nothing): the measurement
+runs in a child process; the parent retries the TPU backend with bounded
+timeouts, then falls back to ``JAX_PLATFORMS=''`` (auto-pick), then to an
+explicit CPU run at a reduced shape. Every path — including total
+failure — ends with the parent printing one parseable JSON line and
+exiting 0.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
+import time
 
-import jax
-import numpy as np
+_CHILD_ENV = "_RAFT_NCUP_BENCH_CHILD"
+_REPO = os.path.dirname(os.path.abspath(__file__))
+_BASELINE_FILE = os.path.join(_REPO, "docs", "perf_baseline.json")
 
-from __graft_entry__ import build_forward
-from raft_ncup_tpu.utils.profiling import measure_throughput
+# Full bench shape (the Sintel fine-tune crop) and the reduced shape used
+# for the CPU fallback (full-res NCUP x12 iters on host CPU takes minutes
+# per call; the fallback exists to record *a* number, clearly labeled).
+FULL = dict(batch=2, height=368, width=768, iters=12)
+SMALL = dict(batch=1, height=96, width=128, iters=4)
 
-# First recorded value (round 1, single TPU chip, 2026-07-29) is the fixed
-# baseline all later rounds are measured against.
-BASELINE_PAIRS_PER_SEC = 1.3
-
-BATCH = 2
-HEIGHT, WIDTH = 368, 768
-ITERS = 12
-WARMUP = 2
-REPS = 5
+TPU_ATTEMPTS = 2
+TPU_TIMEOUT_S = 900  # cold NCUP compile on the chip can take minutes
+FALLBACK_TIMEOUT_S = 1500
 
 
-def main() -> None:
-    platform = jax.devices()[0].platform
+def _baseline_key(platform: str, corr_impl: str, shape: dict) -> str:
+    return (
+        f"{platform}:{corr_impl}:{shape['batch']}x{shape['height']}"
+        f"x{shape['width']}x{shape['iters']}"
+    )
+
+
+def _load_baselines() -> dict:
+    try:
+        with open(_BASELINE_FILE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _child_main() -> None:
+    """Measure in-process and print the result JSON (child only)."""
+    import jax
+    import numpy as np
+
+    from __graft_entry__ import build_forward
+    from raft_ncup_tpu.utils.profiling import measure_throughput
+
+    shape = json.loads(os.environ.get("_BENCH_SHAPE") or json.dumps(FULL))
     corr_impl = os.environ.get("BENCH_CORR_IMPL", "volume")
+    platform = jax.devices()[0].platform
+    if platform == "cpu" and shape == FULL:
+        # Full-res NCUP x12 iters is a TPU workload; on a host-CPU backend
+        # record the reduced shape rather than time out recording nothing.
+        shape = SMALL
+
     fwd, (variables, img1, img2) = build_forward(
-        shape=(BATCH, HEIGHT, WIDTH, 3),
-        iters=ITERS,
+        shape=(shape["batch"], shape["height"], shape["width"], 3),
+        iters=shape["iters"],
         mixed_precision=(platform == "tpu"),
         corr_impl=corr_impl,
     )
@@ -50,23 +87,115 @@ def main() -> None:
     # synchronization point.
     rate = measure_throughput(
         lambda: forward(variables, img1, img2),
-        warmup=WARMUP,
-        reps=REPS,
+        warmup=2,
+        reps=5,
         sync=lambda out: np.asarray(out[1][0, 0, 0, 0]),
     )
-    pairs_per_sec = BATCH * rate
-    vs = pairs_per_sec / BASELINE_PAIRS_PER_SEC if BASELINE_PAIRS_PER_SEC else 0.0
+    pairs_per_sec = shape["batch"] * rate
+
+    key = _baseline_key(platform, corr_impl, shape)
+    baseline = _load_baselines().get(key)
+    vs = pairs_per_sec / baseline if baseline else 1.0
     print(
         json.dumps(
             {
-                "metric": f"raft_nc_dbl frame-pairs/sec/chip @ {ITERS} iters "
-                f"{HEIGHT}x{WIDTH} ({platform}, corr={corr_impl})",
-                "value": round(pairs_per_sec, 3),
+                "metric": (
+                    f"raft_nc_dbl frame-pairs/sec/chip @ {shape['iters']} "
+                    f"iters {shape['height']}x{shape['width']} "
+                    f"({platform}, corr={corr_impl})"
+                ),
+                "value": round(pairs_per_sec, 4),
                 "unit": "pairs/s",
                 "vs_baseline": round(vs, 3),
+                "baseline_key": key,
             }
         )
     )
+
+
+def _run_child(env_overrides: dict, shape: dict, timeout_s: float):
+    """Run the measurement in a child; returns the parsed JSON dict or None."""
+    env = dict(os.environ)
+    env.update(env_overrides)
+    env[_CHILD_ENV] = "1"
+    env["_BENCH_SHAPE"] = json.dumps(shape)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            cwd=_REPO,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"bench attempt timed out after {timeout_s}s", file=sys.stderr)
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            out = json.loads(line)
+            if isinstance(out, dict) and "value" in out:
+                return out
+        except ValueError:
+            continue
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+    print(
+        f"bench attempt failed rc={proc.returncode}:\n" + "\n".join(tail),
+        file=sys.stderr,
+    )
+    return None
+
+
+def main() -> None:
+    if os.environ.get(_CHILD_ENV) == "1":
+        _child_main()
+        return
+
+    result = None
+    # 1) The inherited platform (axon TPU under the driver), with retries —
+    #    round 1 died on a transient backend-init failure.
+    for attempt in range(TPU_ATTEMPTS):
+        result = _run_child({}, FULL, TPU_TIMEOUT_S)
+        if result:
+            break
+        if attempt < TPU_ATTEMPTS - 1:
+            time.sleep(10 * (attempt + 1))
+    # 2) Let jax auto-pick a backend (JAX_PLATFORMS='' is the documented
+    #    escape hatch printed by the round-1 crash itself).
+    if not result:
+        result = _run_child({"JAX_PLATFORMS": ""}, FULL, FALLBACK_TIMEOUT_S)
+    # 3) Explicit CPU at a reduced shape: always yields a number.
+    if not result:
+        result = _run_child({"JAX_PLATFORMS": "cpu"}, SMALL, FALLBACK_TIMEOUT_S)
+    if not result:
+        result = {
+            "metric": "raft_nc_dbl frame-pairs/sec/chip (no backend available)",
+            "value": 0.0,
+            "unit": "pairs/s",
+            "vs_baseline": 0.0,
+        }
+    _maybe_record_baseline(result)
+    print(json.dumps(result))
+
+
+def _maybe_record_baseline(result: dict) -> None:
+    """First successful recording for a (platform, impl, shape) key becomes
+    the fixed baseline later rounds are measured against. The driver
+    commits repo changes at round end, so the file persists."""
+    key = result.pop("baseline_key", None)
+    if not key or not result.get("value"):
+        return
+    baselines = _load_baselines()
+    if key in baselines:
+        return
+    baselines[key] = result["value"]
+    try:
+        os.makedirs(os.path.dirname(_BASELINE_FILE), exist_ok=True)
+        with open(_BASELINE_FILE, "w") as f:
+            json.dump(baselines, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError as e:
+        print(f"could not record baseline: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

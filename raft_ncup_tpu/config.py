@@ -11,8 +11,17 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 from dataclasses import dataclass, field
 from typing import Any, Sequence
+
+# The FlyingChairs train/val split is defined by a 22,871-line 1/2-label
+# file the reference ships at its root (reference: chairs_split.txt,
+# loaded at core/datasets.py:128). It is vendored as package data so the
+# chairs stage works out of the box (22,232 train / 640 val pairs).
+PACKAGED_CHAIRS_SPLIT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "data", "chairs_split.txt"
+)
 
 
 @dataclass(frozen=True)
@@ -168,7 +177,7 @@ class DataConfig:
     root_sintel: str = "datasets/Sintel"
     root_kitti: str = "datasets/KITTI"
     root_hd1k: str = "datasets/HD1k"
-    chairs_split_file: str = "chairs_split.txt"
+    chairs_split_file: str = PACKAGED_CHAIRS_SPLIT
     compressed_ft: bool = False
     num_workers: int = 2
     prefetch: int = 2

@@ -21,7 +21,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from raft_ncup_tpu.config import DataConfig
+from raft_ncup_tpu.config import DataConfig, PACKAGED_CHAIRS_SPLIT
 from raft_ncup_tpu.data.augment import FlowAugmentor, SparseFlowAugmentor
 from raft_ncup_tpu.io import read_flow_kitti, read_gen
 
@@ -125,7 +125,7 @@ class FlyingChairs(FlowDataset):
         aug_params=None,
         split="train",
         root="datasets/FlyingChairs_release/data",
-        split_file="chairs_split.txt",
+        split_file=PACKAGED_CHAIRS_SPLIT,
     ):
         super().__init__(aug_params)
         images = sorted(glob(osp.join(root, "*_img*.png")))

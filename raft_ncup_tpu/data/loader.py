@@ -109,8 +109,15 @@ class FlowLoader:
         )
         return self.dataset.sample(int(index), rng)
 
-    def batches(self, start_epoch: int = 0) -> Iterator[dict]:
-        """Infinite stream of batches, epoch after epoch."""
+    def batches(
+        self, start_epoch: int = 0, start_batch: int = 0
+    ) -> Iterator[dict]:
+        """Infinite stream of batches, epoch after epoch.
+
+        ``start_batch`` skips the first k batches of the start epoch
+        without loading them — the loader is deterministic per
+        (seed, epoch, index), so resuming at (epoch, batch) reproduces the
+        exact stream an uninterrupted run would have seen."""
         stop = threading.Event()
         out: queue.Queue = queue.Queue(maxsize=max(1, self.prefetch))
 
@@ -127,6 +134,7 @@ class FlowLoader:
             try:
                 with ThreadPoolExecutor(self.num_workers) as pool:
                     epoch = start_epoch
+                    skip = start_batch * self.batch_size
                     while not stop.is_set():
                         idx = self._epoch_indices(epoch)
                         limit = (
@@ -134,7 +142,9 @@ class FlowLoader:
                             if self.drop_last
                             else len(idx)
                         )
-                        for s in range(0, limit, self.batch_size):
+                        first = min(skip, limit)
+                        skip = 0
+                        for s in range(first, limit, self.batch_size):
                             chunk = idx[s : s + self.batch_size]
                             samples = list(
                                 pool.map(

@@ -85,7 +85,8 @@ def _uniform_batches(dataset, batch_size: int):
     for i in range(len(dataset)):
         s = dataset.sample(i)
         if shape is not None and s["image1"].shape != shape:
-            yield pending
+            if pending:
+                yield pending
             pending = []
         shape = s["image1"].shape
         pending.append(s)

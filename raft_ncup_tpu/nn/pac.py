@@ -231,9 +231,20 @@ class DJIF(nn.Module):
                 x, (x.shape[1] * self.factor, x.shape[2] * self.factor)
             )
 
+        # The reference distributes the total t/g-branch padding evenly
+        # (paddings_tg = (2, 2, 2) for fs=(9, 1, 5)) rather than per-layer
+        # k//2; intermediate resolutions and border behavior must match for
+        # imported reference DJIF weights to reproduce outputs
+        # (reference: core/pac_upsampler.py:109-110,115-127).
+        total_pad = sum(f // 2 for f in self.fs)
+        pads_tg = (total_pad // 3, total_pad // 3,
+                   total_pad - 2 * (total_pad // 3))
+
         def branch(v, prefix):
             for li, (n, f) in enumerate(zip(self.ns_tg, self.fs)):
-                v = Conv2d(n, f, name=f"{prefix}_conv{li + 1}")(v)
+                v = Conv2d(
+                    n, f, padding=pads_tg[li], name=f"{prefix}_conv{li + 1}"
+                )(v)
                 if li < len(self.ns_tg) - 1:
                     v = jax.nn.relu(v)
             return v

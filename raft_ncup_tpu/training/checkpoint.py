@@ -102,7 +102,17 @@ def load_pretrained_trunk(path: str, variables: dict) -> dict:
     if os.path.isdir(path):
         restored = _restore_variables_only(path)
         return _merge_trunk(restored, variables)
-    return load_torch_checkpoint(path, variables, strict=True)
+    # Stock RAFT checkpoints carry the convex-mask head; a raft_nc_dbl
+    # destination deletes it (reference loads *then* deletes,
+    # core/raft_nc_dbl.py:57-68), so those source keys are expected to be
+    # unmatched — but only when the destination really has no mask head.
+    allow: tuple[str, ...] = ()
+    update_params = variables.get("params", {}).get("update_block", {})
+    if "mask_conv1" not in update_params:
+        allow = (r"^update_block\.mask\.",)
+    return load_torch_checkpoint(
+        path, variables, strict=True, allow_unmatched=allow
+    )
 
 
 def _restore_variables_only(directory: str) -> dict:

@@ -81,6 +81,7 @@ def import_torch_state(
     state: Mapping[str, Any],
     variables: dict,
     strict: bool = True,
+    allow_unmatched: tuple[str, ...] = (),
 ) -> dict:
     """Merge a torch state dict into ``variables`` (from ``RAFT.init``).
 
@@ -90,9 +91,15 @@ def import_torch_state(
       strict: raise if a checkpoint key has no destination (missing
         destinations — e.g. loading a plain RAFT trunk into raft_nc_dbl —
         are always allowed, mirroring the reference's strict=False resume).
+      allow_unmatched: regex patterns (matched against the ``module.``-
+        stripped torch key) for source keys that are *expected* to have no
+        destination even under strict loading — e.g. the convex-mask head
+        when warm-starting a model that deleted it (reference loads the
+        state dict before deleting the head, core/raft_nc_dbl.py:57-68).
     Returns:
       A new variables dict with imported values (float32 numpy).
     """
+    allow_res = [re.compile(p) for p in allow_unmatched]
     state = strip_module_prefix(state)
     params = dict(traverse_util.flatten_dict(variables.get("params", {})))
     stats = dict(traverse_util.flatten_dict(variables.get("batch_stats", {})))
@@ -162,6 +169,8 @@ def import_torch_state(
                 alias = base[:-1] + ("downsample_norm",)
                 if any(k[: len(alias)] == alias for k in (*params, *stats)):
                     continue
+            if any(p.search(tkey) for p in allow_res):
+                continue
             unmatched.append(tkey)
 
     if unmatched and strict:
@@ -176,10 +185,17 @@ def import_torch_state(
     return out
 
 
-def load_torch_checkpoint(path: str, variables: dict, strict: bool = True) -> dict:
+def load_torch_checkpoint(
+    path: str,
+    variables: dict,
+    strict: bool = True,
+    allow_unmatched: tuple[str, ...] = (),
+) -> dict:
     """Load a ``.pth`` file (requires torch, CPU) and import it."""
     import torch
 
     state = torch.load(path, map_location="cpu", weights_only=True)
     state = {k: v.numpy() for k, v in state.items()}
-    return import_torch_state(state, variables, strict=strict)
+    return import_torch_state(
+        state, variables, strict=strict, allow_unmatched=allow_unmatched
+    )

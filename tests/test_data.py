@@ -207,6 +207,22 @@ class TestDatasets:
         assert s["valid"].shape == (96, 128)
         assert s["valid"].all()  # all synthetic flows are small
 
+    def test_packaged_chairs_split_counts(self):
+        """The vendored split file reproduces the reference's exact
+        1/2-label semantics: 22,871 lines, 22,232 train / 640 val
+        (reference: chairs_split.txt via core/datasets.py:128)."""
+        import os
+
+        from raft_ncup_tpu.config import PACKAGED_CHAIRS_SPLIT
+
+        assert os.path.exists(PACKAGED_CHAIRS_SPLIT)
+        labels = np.loadtxt(PACKAGED_CHAIRS_SPLIT, dtype=np.int32)
+        assert labels.shape == (22872,)
+        assert int((labels == 1).sum()) == 22232
+        assert int((labels == 2).sum()) == 640
+        # Config default points at the packaged file out of the box.
+        assert DataConfig().chairs_split_file == PACKAGED_CHAIRS_SPLIT
+
     def test_sintel_pairs_per_scene(self, tmp_path):
         make_sintel_fixture(tmp_path / "Sintel")
         ds = MpiSintel(None, root=str(tmp_path / "Sintel"), dstype="clean")
@@ -273,6 +289,23 @@ class TestLoader:
         np.testing.assert_array_equal(b["image1"], b2["image1"])
         it.close()
         it2.close()
+
+    def test_mid_epoch_resume_matches_uninterrupted_stream(self):
+        """``batches(start_epoch, start_batch)`` reproduces the exact
+        stream an uninterrupted run would have seen from that position —
+        the mid-epoch checkpoint-resume contract train.py relies on."""
+        ds = SyntheticFlowDataset((16, 24), length=12, seed=7)
+        kw = dict(batch_size=3, seed=11, num_workers=1,
+                  shard_index=0, num_shards=1)
+        full = FlowLoader(ds, **kw).batches()
+        stream = [next(full) for _ in range(7)]  # into epoch 1 (4/epoch)
+        full.close()
+
+        resumed = FlowLoader(ds, **kw).batches(start_epoch=1, start_batch=2)
+        got = next(resumed)
+        resumed.close()
+        np.testing.assert_array_equal(got["image1"], stream[6]["image1"])
+        np.testing.assert_array_equal(got["flow"], stream[6]["flow"])
 
     def test_host_sharding_is_disjoint(self):
         ds = SyntheticFlowDataset((16, 16), length=12, seed=0)

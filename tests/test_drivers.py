@@ -222,7 +222,19 @@ class TestEvaluation:
 
 class TestTrainDriver:
     def test_train_resume_cycle(self, tmp_path, monkeypatch):
+        """End-to-end composition through ``main(argv)``: loader, val
+        cadence, checkpoint, restore (reference: train.py:167-261)."""
         import train as train_driver
+        from raft_ncup_tpu import evaluation as eval_mod
+
+        # Record the validation hook instead of scanning real datasets.
+        val_calls: list[int] = []
+
+        def fake_validator(model, variables, data_cfg=None):
+            val_calls.append(1)
+            return {"chairs_epe": 0.0}
+
+        monkeypatch.setitem(eval_mod.VALIDATORS, "chairs", fake_validator)
 
         monkeypatch.chdir(tmp_path)
         base = [
@@ -233,23 +245,28 @@ class TestTrainDriver:
             "--image_size", "32", "48",
             "--batch_size", "2",
             "--iters", "2",
-            "--val_freq", "1000",
+            "--val_freq", "2",
             "--sum_freq", "1",
+            "--validation", "chairs",
             "--synthetic_ok",
             "--num_workers", "1",
             "--root_chairs", str(tmp_path / "missing"),
         ]
-        train_driver.main(base + ["--num_steps", "2"])
+        train_driver.main(base + ["--num_steps", "3"])
         run_dir = tmp_path / "checkpoints" / "smoke"
         assert (run_dir / "log.txt").exists()
         steps = [d for d in os.listdir(run_dir) if d.isdigit()]
-        assert "2" in steps
+        assert "3" in steps
+        # val_freq=2 with 3 steps: validation at steps 2 and 3 (final).
+        assert len(val_calls) == 2
+        log = (run_dir / "log.txt").read_text()
+        assert "chairs_epe" in log
 
         # Resume from the saved state and run 2 more steps.
         train_driver.main(
-            base + ["--num_steps", "4", "--restore_ckpt", str(run_dir)]
+            base + ["--num_steps", "5", "--restore_ckpt", str(run_dir)]
         )
         steps = {d for d in os.listdir(run_dir) if d.isdigit()}
-        assert "4" in steps
+        assert "5" in steps
         log = (run_dir / "log.txt").read_text()
-        assert "restored step 2" in log
+        assert "restored step 3" in log

@@ -216,3 +216,44 @@ def test_load_raft_trunk_into_ncup():
     np.testing.assert_allclose(np.asarray(got), want)
     # Upsampler params untouched (fresh init).
     assert "interpolation_net" in merged["params"]["upsampler"]
+
+
+def test_load_pretrained_trunk_from_stock_raft_pth(tmp_path):
+    """Regression: ``--load_pretrained models/raft-things.pth`` style
+    warm start. A stock RAFT checkpoint carries ``update_block.mask.*``
+    keys; the raft_nc_dbl destination has no mask head, and the strict
+    trunk load must skip exactly those (the reference loads the full
+    state dict *before* deleting the head — core/raft_nc_dbl.py:57-68)
+    while still raising on genuinely unknown keys."""
+    from raft import RAFT as TorchRAFT
+
+    from raft_ncup_tpu.training.checkpoint import load_pretrained_trunk
+    from raft_ncup_tpu.utils.torch_import import strip_module_prefix
+
+    torch.manual_seed(7)
+    tmodel = TorchRAFT(base_args())
+    state = {"module." + k: v for k, v in tmodel.state_dict().items()}
+    assert any(".mask." in k for k in state)  # stock RAFT has the head
+    path = tmp_path / "raft-things.pth"
+    torch.save(state, path)
+
+    cfg = ModelConfig(variant="raft_nc_dbl", dataset="kitti")
+    ours = RAFT(cfg)
+    import jax
+
+    variables = ours.init(jax.random.key(1), (1, H, W, 3))
+    assert "mask_conv1" not in variables["params"]["update_block"]
+
+    merged = load_pretrained_trunk(str(path), variables)
+    got = merged["params"]["fnet"]["conv1"]["kernel"]
+    want = state["module.fnet.conv1.weight"].numpy().transpose(2, 3, 1, 0)
+    np.testing.assert_allclose(np.asarray(got), want)
+
+    # Unknown keys outside the mask-head allowlist still fail loudly.
+    bogus = dict(strip_module_prefix({k: v.numpy() for k, v in state.items()}))
+    bogus["definitely_not_a_module.weight"] = np.zeros((3, 3), np.float32)
+    with pytest.raises(KeyError):
+        import_torch_state(
+            bogus, variables, strict=True,
+            allow_unmatched=(r"^update_block\.mask\.",),
+        )

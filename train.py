@@ -158,11 +158,15 @@ def main(argv=None) -> None:
 
     total = train_cfg.num_steps
     # Resume the data stream where the restored run left off: the loader
-    # is deterministic per (seed, epoch, index), so the epoch offset is
-    # derived from the restored step.
+    # is deterministic per (seed, epoch, index), so the (epoch, batch)
+    # position is derived from the restored step and the intra-epoch
+    # batches already consumed are skipped without loading.
     step_i = int(state.step)
     start_step = step_i
-    batches = loader.batches(start_epoch=step_i // max(len(loader), 1))
+    per_epoch = max(len(loader), 1)
+    batches = loader.batches(
+        start_epoch=step_i // per_epoch, start_batch=step_i % per_epoch
+    )
     profiling = False
     profile_scope = contextlib.ExitStack()
     try:
