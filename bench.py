@@ -61,6 +61,15 @@ def _load_baselines() -> dict:
 def _child_main() -> None:
     """Measure in-process and print the result JSON (child only)."""
     import jax
+
+    # The axon boot hook bakes JAX_PLATFORMS=axon into jax.config at
+    # interpreter start, which overrides the env var — the fallbacks must
+    # force the config itself (the tests/conftest.py recipe).
+    if "_BENCH_FORCE_PLATFORM" in os.environ:
+        jax.config.update(
+            "jax_platforms", os.environ["_BENCH_FORCE_PLATFORM"]
+        )
+
     import numpy as np
 
     from __graft_entry__ import build_forward
@@ -163,10 +172,16 @@ def main() -> None:
     # 2) Let jax auto-pick a backend (JAX_PLATFORMS='' is the documented
     #    escape hatch printed by the round-1 crash itself).
     if not result:
-        result = _run_child({"JAX_PLATFORMS": ""}, FULL, FALLBACK_TIMEOUT_S)
+        result = _run_child(
+            {"JAX_PLATFORMS": "", "_BENCH_FORCE_PLATFORM": ""},
+            FULL, FALLBACK_TIMEOUT_S,
+        )
     # 3) Explicit CPU at a reduced shape: always yields a number.
     if not result:
-        result = _run_child({"JAX_PLATFORMS": "cpu"}, SMALL, FALLBACK_TIMEOUT_S)
+        result = _run_child(
+            {"JAX_PLATFORMS": "cpu", "_BENCH_FORCE_PLATFORM": "cpu"},
+            SMALL, FALLBACK_TIMEOUT_S,
+        )
     if not result:
         result = {
             "metric": "raft_nc_dbl frame-pairs/sec/chip (no backend available)",

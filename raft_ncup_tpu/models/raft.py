@@ -210,16 +210,32 @@ class RAFT:
 
         elif cfg.corr_impl == "pallas":
             try:
-                from raft_ncup_tpu.ops.corr_pallas import corr_lookup_pallas
+                from raft_ncup_tpu.ops.corr_pallas import (
+                    corr_lookup_pallas,
+                    fits_vmem,
+                )
             except ImportError as e:
                 raise NotImplementedError(
                     "corr_impl='pallas' requires raft_ncup_tpu.ops.corr_pallas"
                 ) from e
 
-            def corr_fn(coords):
-                return corr_lookup_pallas(
-                    fmap1, fmap2, coords, radius, cfg.corr_levels
-                )
+            # The kernel keeps the whole fmap2 level resident in VMEM;
+            # shapes past the budget (1080p-class) take the equivalent
+            # XLA on-the-fly path instead (shapes are static at trace
+            # time, so this is a compile-time choice).
+            if fits_vmem(fmap2.shape[1], fmap2.shape[2], fmap2.shape[3], radius):
+
+                def corr_fn(coords):
+                    return corr_lookup_pallas(
+                        fmap1, fmap2, coords, radius, cfg.corr_levels
+                    )
+
+            else:
+
+                def corr_fn(coords):
+                    return corr_lookup_onthefly(
+                        fmap1, fmap2, coords, radius, cfg.corr_levels
+                    )
 
         else:
             raise ValueError(f"unknown corr_impl: {cfg.corr_impl!r}")

@@ -20,15 +20,172 @@ from typing import Any, Sequence
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from raft_ncup_tpu.config import UpsamplerConfig
 from raft_ncup_tpu.nn.layers import Conv2d
 from raft_ncup_tpu.ops.pac import (
     extract_patches,
     pac_gaussian_kernel,
+    pac_kernel2d,
+    pacconv2d,
     pacconv_transpose2d,
+    pacpool2d,
+    smooth_kernel_2d,
     zero_stuff_mask,
 )
+
+
+def parse_kernel_type(kernel_type: str) -> dict:
+    """Parse the reference's kernel-type strings (reference:
+    core/pac_modules.py:545-563,672-674): 'gaussian' or
+    'inv_{alpha}_{lambda}[_asym][_fixed]'."""
+    if kernel_type == "gaussian":
+        return dict(base="gaussian", alpha=None, lam=None,
+                    asym=False, fixed=False)
+    if kernel_type.startswith("inv_"):
+        parts = kernel_type.split("_")
+        return dict(
+            base="inv",
+            alpha=float(parts[1]),
+            lam=float(parts[2]),
+            asym="asym" in parts[3:],
+            fixed="fixed" in parts[3:],
+        )
+    raise ValueError(f"kernel_type set to invalid value ({kernel_type})")
+
+
+class _PacKernelMixin:
+    """Shared adapting-kernel plumbing for the PAC module wrappers: the
+    kernel-type string, smooth-kernel options, and the learnable
+    inv-alpha/lambda and 'full_*' smooth-kernel parameters."""
+
+    def _kernel_params(self, n_channels: int) -> dict:
+        kt = parse_kernel_type(self.kernel_type)
+        kw: dict = dict(kernel_type=kt["base"], asym=kt["asym"])
+        if kt["base"] == "inv":
+            shape = (n_channels,) if n_channels > 0 else ()
+            if kt["fixed"]:
+                kw["inv_alpha"] = jnp.full(shape, kt["alpha"])
+                kw["inv_lambda"] = jnp.full(shape, kt["lam"])
+            else:
+                kw["inv_alpha"] = self.param(
+                    "inv_alpha", lambda rng: jnp.full(shape, kt["alpha"])
+                )
+                kw["inv_lambda"] = self.param(
+                    "inv_lambda", lambda rng: jnp.full(shape, kt["lam"])
+                )
+        if self.smooth_kernel_type == "none":
+            pass
+        elif self.smooth_kernel_type.startswith("full_"):
+            sz = int(self.smooth_kernel_type.split("_")[-1])
+            # Learnable smoothing filter, init 1/size^2 (reference:
+            # core/pac_modules.py:566-567,641-642).
+            kw["smooth_kernel"] = self.param(
+                "smooth_kernel",
+                lambda rng: jnp.full((sz, sz), 1.0 / (sz * sz)),
+            )
+        else:
+            kw["smooth_kernel"] = smooth_kernel_2d(self.smooth_kernel_type)
+        return kw
+
+
+class PacConv2d(nn.Module, _PacKernelMixin):
+    """Pixel-adaptive convolution module (reference:
+    core/pac_modules.py:662-710): a standard conv whose spatially-varying
+    kernel is the product of a learned filter and a guidance-adapting
+    kernel. ``__call__(x, guide, mask=None)``; returns the output, or
+    ``(output, mask_out)`` when ``mask`` is given."""
+
+    features: int
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 0  # torch Conv2d default (reference: :676)
+    dilation: int = 1
+    use_bias: bool = True
+    kernel_type: str = "gaussian"
+    smooth_kernel_type: str = "none"
+    normalize_kernel: bool = False
+    shared_filters: bool = False
+
+    @nn.compact
+    def __call__(self, x, guide, mask=None):
+        k, cin = self.kernel_size, x.shape[-1]
+        if self.shared_filters and self.features != cin:
+            raise ValueError("shared_filters requires features == in-channels")
+        # torch 'uniform' filler: U(-b, b), b = 1/sqrt(in*k*k), scaled by
+        # in-channels for shared filters (reference: :586-596).
+        bound = 1.0 / math.sqrt(cin * k * k)
+        if self.shared_filters:
+            bound *= cin
+            wshape = (k * k,)
+        else:
+            wshape = (k * k, cin, self.features)
+        weight = self.param(
+            "weight",
+            lambda rng: jax.random.uniform(
+                rng, wshape, minval=-bound, maxval=bound
+            ),
+        )
+        bias = (
+            self.param(
+                "bias",
+                lambda rng: jax.random.uniform(
+                    rng, (self.features,), minval=-bound, maxval=bound
+                ),
+            )
+            if self.use_bias
+            else None
+        )
+        kernel, mask_out = pac_kernel2d(
+            guide, k, stride=self.stride, dilation=self.dilation,
+            padding=self.padding, normalize_kernel=self.normalize_kernel,
+            mask=mask, **self._kernel_params(0),
+        )
+        pad = (self.padding, self.padding)
+        out = pacconv2d(
+            x, kernel, weight, bias, self.dilation, pad, pad,
+            stride=self.stride, shared_filters=self.shared_filters,
+        )
+        return out if mask_out is None else (out, mask_out)
+
+
+class PacPool2d(nn.Module, _PacKernelMixin):
+    """Pixel-adaptive pooling module (reference:
+    core/pac_modules.py:765-816): kernel-weighted window sum, optionally
+    with per-channel kernels. ``out_channels`` sizes the learnable
+    inv-alpha/lambda for channel-wise 'inv_*' kernels."""
+
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 0
+    dilation: int = 1
+    kernel_type: str = "gaussian"
+    smooth_kernel_type: str = "none"
+    channel_wise: bool = False
+    normalize_kernel: bool = False
+    out_channels: int = -1
+
+    @nn.compact
+    def __call__(self, x, guide, mask=None):
+        if self.channel_wise and guide.shape[-1] != x.shape[-1]:
+            raise ValueError(
+                "input and kernel must have the same number of channels "
+                "when channel_wise=True"
+            )
+        n_ch = self.out_channels if self.channel_wise else 0
+        kernel, mask_out = pac_kernel2d(
+            guide, self.kernel_size, stride=self.stride,
+            dilation=self.dilation, padding=self.padding,
+            channel_wise=self.channel_wise,
+            normalize_kernel=self.normalize_kernel,
+            mask=mask, **self._kernel_params(n_ch),
+        )
+        out = pacpool2d(
+            x, kernel, self.kernel_size, self.dilation,
+            stride=self.stride, padding=self.padding,
+        )
+        return out if mask_out is None else (out, mask_out)
 
 
 def _fold_channels(x: jax.Array) -> tuple[jax.Array, int]:
@@ -62,7 +219,7 @@ def _resize_half_pixel(x: jax.Array, out_hw: tuple[int, int]) -> jax.Array:
     )
 
 
-class PacConvTranspose2d(nn.Module):
+class PacConvTranspose2d(nn.Module, _PacKernelMixin):
     """Guided 2x-or-more upsampling convolution (reference:
     core/pac_modules.py:628-722 module, native forward :462-467).
 
@@ -79,6 +236,30 @@ class PacConvTranspose2d(nn.Module):
     normalize_kernel: bool = False
     use_bias: bool = True
     identity_init: bool = False
+    kernel_type: str = "gaussian"
+    smooth_kernel_type: str = "none"
+    filler: str = "uniform"
+
+    def _linear_filler(self) -> jax.Array:
+        """Bilinear-interpolation weights on the channel diagonal, the
+        'linear' filler (reference: core/pac_modules.py:597-611)."""
+        k, s = self.kernel_size, self.stride
+        p = (k - (2 * s - 1)) // 2
+        w1 = (
+            np.concatenate(
+                [np.zeros(p), np.arange(1, s), np.arange(s, 0, -1), np.zeros(p)]
+            )
+            / s
+        )
+        if self.normalize_kernel:
+            w1 = w1 * np.array(
+                [((k - j - 1) // s) + (j // s) + 1.0 for j in range(k)]
+            )
+        w2 = (w1[:, None] * w1[None, :]).reshape(k * k)
+        eye = np.zeros((k * k, self.in_ch, self.out_ch), np.float32)
+        for c in range(min(self.in_ch, self.out_ch)):
+            eye[:, c, c] = w2
+        return jnp.asarray(eye)
 
     @nn.compact
     def __call__(self, x: jax.Array, guide: jax.Array) -> jax.Array:
@@ -89,6 +270,9 @@ class PacConvTranspose2d(nn.Module):
             for c in range(min(self.in_ch, self.out_ch)):
                 eye = eye.at[:, c, c].set(1.0)
             weight = self.param("weight", lambda rng: eye)
+        elif self.filler == "linear":
+            init = self._linear_filler()
+            weight = self.param("weight", lambda rng: init)
         else:
             # Torch ConvTranspose2d default init: U(-b, b), b = 1/sqrt(fan).
             bound = 1.0 / math.sqrt(self.in_ch * k * k)
@@ -99,8 +283,16 @@ class PacConvTranspose2d(nn.Module):
                     minval=-bound, maxval=bound,
                 ),
             )
-        bias = (
-            self.param(
+        if not self.use_bias:
+            bias = None
+        elif self.filler == "linear":
+            # The linear filler zeroes the bias (reference:
+            # core/pac_modules.py:610-611).
+            bias = self.param(
+                "bias", lambda rng: jnp.zeros((self.out_ch,))
+            )
+        else:
+            bias = self.param(
                 "bias",
                 lambda rng: jax.random.uniform(
                     rng, (self.out_ch,),
@@ -108,11 +300,17 @@ class PacConvTranspose2d(nn.Module):
                     maxval=1.0 / math.sqrt(self.in_ch * k * k),
                 ),
             )
-            if self.use_bias
-            else None
-        )
 
-        kernel = pac_gaussian_kernel(guide, k)
+        # Transposed kernels are computed at the OUTPUT resolution with
+        # 'same' padding — asymmetric split for even kernel sizes, as the
+        # historical gaussian path padded (reference: core/pac_modules.py:365-367).
+        span = k - 1
+        kernel, _ = pac_kernel2d(
+            guide, k,
+            pad_lo=(span // 2, span // 2),
+            pad_hi=(span - span // 2, span - span // 2),
+            **self._kernel_params(0),
+        )
         if self.normalize_kernel:
             # Taps landing on stuffed zeros contribute nothing; normalize
             # over the real-sample taps (reference:
@@ -235,10 +433,14 @@ class DJIF(nn.Module):
         # (paddings_tg = (2, 2, 2) for fs=(9, 1, 5)) rather than per-layer
         # k//2; intermediate resolutions and border behavior must match for
         # imported reference DJIF weights to reproduce outputs
-        # (reference: core/pac_upsampler.py:109-110,115-127).
+        # (reference: core/pac_upsampler.py:109-110,115-127). Generalized
+        # to any layer count: equal shares, remainder on the last layer.
         total_pad = sum(f // 2 for f in self.fs)
-        pads_tg = (total_pad // 3, total_pad // 3,
-                   total_pad - 2 * (total_pad // 3))
+        n_layers = len(self.fs)
+        share = total_pad // n_layers
+        pads_tg = (share,) * (n_layers - 1) + (
+            total_pad - (n_layers - 1) * share,
+        )
 
         def branch(v, prefix):
             for li, (n, f) in enumerate(zip(self.ns_tg, self.fs)):

@@ -1,18 +1,29 @@
-"""Pallas TPU kernel for the multi-scale correlation-window lookup.
+"""Pallas TPU kernel: fused, volume-free correlation-window lookup.
 
-The XLA paths (raft_ncup_tpu.ops.corr) express the (2r+1)^2-tap bilinear
-window sample as a general gather. This kernel exploits the window's
-structure instead: every tap of a query's window shares the same
-fractional offset — the window is an integer-aligned grid shifted by one
-sub-pixel amount — so the whole K x K window equals a 2 x 2 bilinear blend
-of a (K+1) x (K+1) integer-aligned patch of the volume. Per query that is
-one dynamic-start patch load from VMEM plus four shifted multiply-adds,
-with no gather anywhere.
+The XLA paths (raft_ncup_tpu.ops.corr) either materialize the O((HW)^2)
+all-pairs volume (`volume`) or bilinearly gather fmap2 taps (`onthefly`).
+This kernel fuses the per-level dot product INTO the windowed lookup, so
+the volume never exists anywhere — the §2a(a) design from SURVEY.md:
+
+- Every tap of a query's (2r+1)^2 window shares the same fractional
+  offset: the window is an integer-aligned grid shifted by one sub-pixel
+  amount, so the whole K x K window equals a 2 x 2 bilinear blend of a
+  (K+1) x (K+1) integer-aligned patch of correlations.
+- That patch is `sum_c f1[q, c] * f2[iy : iy+K+1, ix : ix+K+1, c]` — a
+  dynamic-start slice of the VMEM-resident fmap2 level (dynamic starts on
+  the major and sublane dims, full lanes; the layout Mosaic supports)
+  followed by a lane reduction on the VPU. No gather, no roll, and HBM
+  traffic is fmap2 once per query block instead of a volume pass.
 
 Zero-padding semantics (out-of-bounds taps contribute zero, matching
-``grid_sample``) come from pre-padding each level with K+2 zeros per side:
-window starts are clamped into the padded array, and any fully-OOB window
-lands entirely inside the zero margin.
+``grid_sample``) come from pre-padding each level with K+2 zeros per
+side; window starts are clamped into the padded array, and any fully-OOB
+window lands entirely inside the zero margin.
+
+VMEM budget: the padded level must fit on-chip (~6.6 MB for the 368x768
+training crop's level 0 at C=256). `fits_vmem` reports whether a shape
+qualifies; the model falls back to the XLA on-the-fly path otherwise
+(1080p belongs to `onthefly` — see tests/test_highres.py).
 
 The kernel is forward-only; ``corr_lookup_pallas`` wraps it in a
 ``jax.custom_vjp`` whose backward runs the XLA on-the-fly path's VJP, so
@@ -27,36 +38,40 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from raft_ncup_tpu.ops.corr import (
     _pool_fmap_pyramid,
     corr_lookup_onthefly,
 )
 
-_VMEM_BUDGET = 8 * 1024 * 1024  # soft cap per volume block
+_VMEM_BUDGET = 10 * 1024 * 1024  # padded fmap2 level + working set
 
 
-def _query_block(hp: int, wp: int) -> int:
-    """Largest power-of-two query block whose volume slab fits the budget."""
-    q = 256
-    while q > 8 and q * hp * wp * 4 > _VMEM_BUDGET:
-        q //= 2
-    return q
+def _padded_hw(h: int, w: int, radius: int) -> tuple[int, int, int]:
+    # A fully-OOB window is clamped to the array edge and must land
+    # entirely inside the zero margin: K + 2 zeros per side.
+    pad = 2 * radius + 3
+    return h + 2 * pad, w + 2 * pad, pad
 
 
-def _lookup_kernel(coords_ref, vol_ref, out_ref, *, radius, pad, level):
-    """One (query-block) program: sample the K x K window per query.
+def fits_vmem(h: int, w: int, channels: int, radius: int = 4) -> bool:
+    """Whether the level-0 fmap2 slab fits the kernel's VMEM budget."""
+    hp, wp, _ = _padded_hw(h, w, radius)
+    return hp * wp * channels * 4 <= _VMEM_BUDGET
 
+
+def _lookup_kernel(f1_ref, coords_ref, f2_ref, out_ref, *, radius, pad, level):
+    """One (batch, query-block) program.
+
+    f1_ref:     (Q, C) float32 — query features, pre-scaled by 1/sqrt(C).
     coords_ref: (Q, 2) float32 — full-res query centers (x, y).
-    vol_ref:    (Q, Hp, Wp) float32 — per-query padded volume slab.
+    f2_ref:     (Hp, Wp, C) float32 — zero-padded fmap2 level.
     out_ref:    (Q, K, K) float32 — window values in natural (y, x) order;
                 the caller transposes to the reference's x-major tap order
-                (core/corr.py:31-37). Mosaic cannot reshape/transpose the
-                9x9 tile in-kernel.
+                (core/corr.py:31-37).
     """
     K = 2 * radius + 1
-    Hp, Wp = vol_ref.shape[1], vol_ref.shape[2]
+    Hp, Wp = f2_ref.shape[0], f2_ref.shape[1]
     inv = 1.0 / (2.0**level)
 
     def body(q, _):
@@ -68,22 +83,14 @@ def _lookup_kernel(coords_ref, vol_ref, out_ref, *, radius, pad, level):
         fy = cy - y0
         ix = jnp.clip(x0.astype(jnp.int32) - radius + pad, 0, Wp - (K + 1))
         iy = jnp.clip(y0.astype(jnp.int32) - radius + pad, 0, Hp - (K + 1))
-        # Mosaic allows dynamic-start slicing on the sublane dim but not
-        # the lane (minor) dim, and dynamic rotates only on the lane dim:
-        # slice rows dynamically, rotate columns so the window starts at
-        # lane 0, then static-slice. The clamp above keeps
-        # [iy, iy+K] x [ix, ix+K] in bounds, so the rotation never wraps
-        # real data into the window.
-        rows = vol_ref[q, pl.ds(iy, K + 1), :]  # (K+1, Wp)
-        # pltpu.roll requires a non-negative shift; left-rotate by ix ==
-        # right-rotate by Wp - ix (ix == 0 must stay 0, not Wp).
-        rows = pltpu.roll(rows, jnp.where(ix == 0, 0, Wp - ix), 1)
-        patch = rows[:, : K + 1]  # rows = y, cols = x
+        patch = f2_ref[pl.ds(iy, K + 1), pl.ds(ix, K + 1), :]  # (K+1,K+1,C)
+        f1q = f1_ref[q, :]  # (C,)
+        corr = (patch * f1q[None, None, :]).sum(-1)  # (K+1, K+1): y, x
         win = (
-            (1 - fy) * (1 - fx) * patch[:K, :K]
-            + (1 - fy) * fx * patch[:K, 1:]
-            + fy * (1 - fx) * patch[1:, :K]
-            + fy * fx * patch[1:, 1:]
+            (1 - fy) * (1 - fx) * corr[:K, :K]
+            + (1 - fy) * fx * corr[:K, 1:]
+            + fy * (1 - fx) * corr[1:, :K]
+            + fy * fx * corr[1:, 1:]
         )
         out_ref[q] = win
         return 0
@@ -92,40 +99,47 @@ def _lookup_kernel(coords_ref, vol_ref, out_ref, *, radius, pad, level):
 
 
 def _lookup_one_level(
-    vol: jax.Array,  # (N, Hl, Wl) per-query volume, N = B*H*W
-    coords: jax.Array,  # (N, 2)
+    f1: jax.Array,  # (B, N, C) pre-scaled query features, N = H*W
+    f2l: jax.Array,  # (B, Hl, Wl, C) pooled fmap2 level
+    coords: jax.Array,  # (B, N, 2)
     radius: int,
     level: int,
     interpret: bool = False,
+    query_block: int = 512,
 ) -> jax.Array:
-    N, Hl, Wl = vol.shape
+    B, N, C = f1.shape
+    _, Hl, Wl, _ = f2l.shape
     K = 2 * radius + 1
-    pad = K + 2
-    volp = jnp.pad(vol, ((0, 0), (pad, pad), (pad, pad)))
-    Hp, Wp = Hl + 2 * pad, Wl + 2 * pad
+    Hp, Wp, pad = _padded_hw(Hl, Wl, radius)
+    f2p = jnp.pad(f2l, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
 
-    qblk = _query_block(Hp, Wp)
+    qblk = min(query_block, N)
     n_pad = (-N) % qblk
     if n_pad:
-        volp = jnp.pad(volp, ((0, n_pad), (0, 0), (0, 0)))
-        coords = jnp.pad(coords, ((0, n_pad), (0, 0)))
+        f1 = jnp.pad(f1, ((0, 0), (0, n_pad), (0, 0)))
+        coords = jnp.pad(coords, ((0, 0), (0, n_pad), (0, 0)))
     n_blocks = (N + n_pad) // qblk
 
     out = pl.pallas_call(
         functools.partial(
             _lookup_kernel, radius=radius, pad=pad, level=level
         ),
-        grid=(n_blocks,),
+        grid=(B, n_blocks),
         in_specs=[
-            pl.BlockSpec((qblk, 2), lambda i: (i, 0)),
-            pl.BlockSpec((qblk, Hp, Wp), lambda i: (i, 0, 0)),
+            pl.BlockSpec((None, qblk, C), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, qblk, 2), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Hp, Wp, C), lambda b, i: (b, 0, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((qblk, K, K), lambda i: (i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((N + n_pad, K, K), jnp.float32),
+        out_specs=pl.BlockSpec((None, qblk, K, K), lambda b, i: (b, i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, N + n_pad, K, K), jnp.float32),
         interpret=interpret,
-    )(coords.astype(jnp.float32), volp.astype(jnp.float32))
-    # (N, K_y, K_x) -> x-major taps (reference order).
-    return out[:N].transpose(0, 2, 1).reshape(N, K * K)
+    )(
+        f1.astype(jnp.float32),
+        coords.astype(jnp.float32),
+        f2p.astype(jnp.float32),
+    )
+    # (B, N, K_y, K_x) -> x-major taps (reference order).
+    return out[:, :N].transpose(0, 1, 3, 2).reshape(B, N, K * K)
 
 
 def _forward(
@@ -136,28 +150,17 @@ def _forward(
     num_levels: int,
     interpret: bool = False,
 ) -> jax.Array:
-    """Materialize the pyramid (einsum on the MXU), then kernel-sample it."""
+    """Volume-free fused lookup over all pyramid levels."""
     B, H, W, C = fmap1.shape
-    f1 = fmap1.reshape(B, H * W, C).astype(jnp.float32)
-    f2_levels = _pool_fmap_pyramid(fmap2.astype(jnp.float32), num_levels)
     scale = 1.0 / math.sqrt(C)
+    f1 = (fmap1.reshape(B, H * W, C) * scale).astype(jnp.float32)
+    f2_levels = _pool_fmap_pyramid(fmap2.astype(jnp.float32), num_levels)
+    cflat = coords.astype(jnp.float32).reshape(B, H * W, 2)
 
-    cflat = coords.astype(jnp.float32).reshape(B * H * W, 2)
-    outs = []
-    for lvl, f2l in enumerate(f2_levels):
-        Hl, Wl = f2l.shape[1], f2l.shape[2]
-        vol = (
-            jnp.einsum(
-                "bqc,byxc->bqyx",
-                f1,
-                f2l,
-                preferred_element_type=jnp.float32,
-            )
-            * scale
-        ).reshape(B * H * W, Hl, Wl)
-        outs.append(
-            _lookup_one_level(vol, cflat, radius, lvl, interpret=interpret)
-        )
+    outs = [
+        _lookup_one_level(f1, f2l, cflat, radius, lvl, interpret=interpret)
+        for lvl, f2l in enumerate(f2_levels)
+    ]
     K = 2 * radius + 1
     return jnp.concatenate(outs, axis=-1).reshape(
         B, H, W, num_levels * K * K
@@ -175,7 +178,8 @@ def corr_lookup_pallas(
 ) -> jax.Array:
     """Fused correlation lookup: (B,H,W,C) x2 + (B,H,W,2) ->
     (B, H, W, L*(2r+1)^2). Equivalent to the XLA paths in
-    ``raft_ncup_tpu.ops.corr`` up to float associativity."""
+    ``raft_ncup_tpu.ops.corr`` up to float associativity; never
+    materializes the correlation volume."""
     return _forward(fmap1, fmap2, coords, radius, num_levels, interpret)
 
 

@@ -76,6 +76,161 @@ def pac_gaussian_kernel(
     return jnp.exp(-0.5 * d2)
 
 
+def smooth_kernel_2d(kind: str) -> jax.Array:
+    """Fixed smoothing kernels for the ``smooth_kernel_type`` options
+    (reference: core/pac_modules.py:566-580): 'gaussian' is the separable
+    [.25, .5, .25] stencil; 'average_{sz}' is a box filter."""
+    if kind == "gaussian":
+        s1 = jnp.asarray([0.25, 0.5, 0.25])
+    elif kind.startswith("average_"):
+        sz = int(kind.split("_")[-1])
+        s1 = jnp.full((sz,), 1.0 / sz)
+    else:
+        raise ValueError(f"unknown fixed smooth kernel {kind!r}")
+    return s1[:, None] * s1[None, :]
+
+
+def _smoothed_center(
+    guide: jax.Array,
+    smooth_kernel: jax.Array,
+    ksize: int,
+    stride: int,
+    pad: tuple[int, int],
+) -> jax.Array:
+    """Window-center feature as a smoothed (depthwise-filtered) guide, the
+    ``smooth_kernel_type != 'none'`` branch (reference:
+    core/pac_modules.py:380-387): conv the guide with the small kernel at
+    padding ``pad - (ksize - smooth_sz)//2`` (cropping when negative) so
+    each output aligns with its window's center."""
+    sh, sw = smooth_kernel.shape
+    sp_h = pad[0] - (ksize - sh) // 2
+    sp_w = pad[1] - (ksize - sw) // 2
+
+    def crop_pad(x, amount, axis):
+        if amount >= 0:
+            cfg = [(0, 0)] * x.ndim
+            cfg[axis] = (amount, amount)
+            return jnp.pad(x, cfg)
+        return jax.lax.slice_in_dim(x, -amount, x.shape[axis] + amount, axis=axis)
+
+    g = crop_pad(crop_pad(guide, sp_h, 1), sp_w, 2)
+    C = g.shape[-1]
+    w = jnp.broadcast_to(smooth_kernel[:, :, None, None], (sh, sw, 1, C))
+    out = jax.lax.conv_general_dilated(
+        g.astype(smooth_kernel.dtype), w,
+        window_strides=(stride, stride), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=C,
+    )
+    return out
+
+
+def pac_kernel2d(
+    guide: jax.Array,
+    ksize: int,
+    *,
+    stride: int = 1,
+    dilation: int = 1,
+    padding: int = 0,
+    kernel_type: str = "gaussian",
+    inv_alpha: Optional[jax.Array] = None,
+    inv_lambda: Optional[jax.Array] = None,
+    asym: bool = False,
+    smooth_kernel: Optional[jax.Array] = None,
+    channel_wise: bool = False,
+    normalize_kernel: bool = False,
+    mask: Optional[jax.Array] = None,
+    pad_lo: Optional[tuple[int, int]] = None,
+    pad_hi: Optional[tuple[int, int]] = None,
+) -> tuple[jax.Array, Optional[jax.Array]]:
+    """General adapting-kernel computation — the full ``packernel2d``
+    capability surface (reference: core/pac_modules.py:332-424, native
+    path), channel-last:
+
+    - ``kernel_type``: 'gaussian' -> exp(-0.5 d2); 'inv' ->
+      alpha + (d2 + 1e-4)^(0.5 lambda) with learnable alpha/lambda;
+      ``asym`` relu's the guide difference before squaring ('_asym').
+    - ``smooth_kernel``: window-center feature is a smoothed guide
+      instead of the center tap.
+    - ``channel_wise``: keep per-channel kernels (B, H, W, k*k, C).
+    - ``mask``: (B, H, W, 1) validity; the kernel is masked and, unless
+      ``normalize_kernel``, scaled by (mask coverage / full coverage);
+      returns the output-resolution mask as the second element.
+    - ``normalize_kernel``: divide by the window sum.
+
+    Returns ``(kernel, mask_out)``; ``mask_out`` is None without ``mask``.
+    ``pad_lo``/``pad_hi`` override the symmetric ``padding`` (the
+    transposed wrappers need the asymmetric 'same' split for even kernel
+    sizes).
+    """
+    pad = (padding, padding)
+    lo = pad if pad_lo is None else pad_lo
+    hi = pad if pad_hi is None else pad_hi
+    patches = extract_patches(guide, ksize, dilation, lo, hi)
+    patches = patches[:, ::stride, ::stride]
+
+    if smooth_kernel is None:
+        center = patches[:, :, :, (ksize * ksize) // 2, :]
+    else:
+        center = _smoothed_center(guide, smooth_kernel, ksize, stride, lo)
+    diff = patches - center[:, :, :, None, :]
+    if asym:
+        diff = jax.nn.relu(diff)
+    d2 = diff * diff
+    if not channel_wise:
+        d2 = d2.sum(axis=-1)
+
+    if kernel_type == "gaussian":
+        kernel = jnp.exp(-0.5 * d2)
+    elif kernel_type == "inv":
+        # alpha/lambda broadcast over a trailing per-channel axis when
+        # channel_wise (reference: core/pac_modules.py:400-403).
+        a = jnp.reshape(inv_alpha, (1, 1, 1, 1, -1) if channel_wise else (1, 1, 1, -1))
+        lam = jnp.reshape(inv_lambda, (1, 1, 1, 1, -1) if channel_wise else (1, 1, 1, -1))
+        if not channel_wise:
+            d2 = d2[..., None]
+        kernel = a + jnp.power(d2 + 1e-4, 0.5 * lam)
+        if not channel_wise and kernel.shape[-1] == 1:
+            kernel = kernel[..., 0]
+    else:
+        raise ValueError(f"unknown kernel_type {kernel_type!r}")
+
+    per_channel = kernel.ndim == 5  # (B, H', W', k*k[, C])
+    norm = None
+    mask_out = None
+    if mask is not None or normalize_kernel:
+        # In-bounds indicator: taps landing on zero padding don't count
+        # (reference mask_pattern, core/pac_modules.py:353-356).
+        ones = extract_patches(
+            jnp.ones((*guide.shape[:3], 1), guide.dtype),
+            ksize, dilation, lo, hi,
+        )[:, ::stride, ::stride, :, 0]
+    if mask is not None:
+        mask = mask.astype(guide.dtype)
+        mpat = extract_patches(mask, ksize, dilation, lo, hi)
+        mpat = mpat[:, ::stride, ::stride, :, 0]
+        if not normalize_kernel:
+            norm = mpat.sum(axis=3, keepdims=True) / ones.sum(
+                axis=3, keepdims=True
+            )
+            if per_channel:
+                norm = norm[..., None]
+    else:
+        mpat = ones if normalize_kernel else None
+    if mpat is not None:
+        kernel = kernel * (mpat[..., None] if per_channel else mpat)
+    if normalize_kernel:
+        norm = kernel.sum(axis=3, keepdims=True)
+    if norm is not None:
+        empty = (norm == 0).astype(kernel.dtype)
+        kernel = kernel / (norm + empty)
+        if mask is not None:
+            mask_out = 1.0 - empty.reshape(
+                kernel.shape[0], *kernel.shape[1:3], -1
+            )[..., :1]
+    return kernel, mask_out
+
+
 def zero_stuff_mask(
     shape_hw: tuple[int, int], stride: int, dtype=jnp.float32
 ) -> jax.Array:
@@ -105,22 +260,33 @@ def pacconv2d(
     dilation: int = 1,
     pad_lo: Optional[tuple[int, int]] = None,
     pad_hi: Optional[tuple[int, int]] = None,
+    stride: int = 1,
+    shared_filters: bool = False,
 ) -> jax.Array:
-    """Stride-1 PAC convolution (reference: core/pac_modules.py:440-443).
+    """PAC convolution (reference: core/pac_modules.py:427-449 native).
 
     x: (B, H, W, Cin); kernel: (B, H', W', k*k) from
-    :func:`pac_gaussian_kernel`; weight: (k*k, Cin, Cout).
+    :func:`pac_gaussian_kernel` / :func:`pac_kernel2d`; weight:
+    (k*k, Cin, Cout) — or (k*k,) with ``shared_filters`` (one spatial
+    filter applied to every channel, reference: :439-441).
     """
     ksize = int(round(weight.shape[0] ** 0.5))
     patches = extract_patches(x, ksize, dilation, pad_lo, pad_hi)
-    return _pac_contract(patches, kernel, weight, bias)
+    patches = patches[:, ::stride, ::stride]
+    return _pac_contract(patches, kernel, weight, bias, shared_filters)
 
 
-def _pac_contract(patches, kernel, weight, bias):
-    out = jnp.einsum(
-        "bhwkc,bhwk,kco->bhwo", patches, kernel, weight,
-        preferred_element_type=jnp.float32,
-    )
+def _pac_contract(patches, kernel, weight, bias, shared_filters=False):
+    if shared_filters:
+        out = jnp.einsum(
+            "bhwkc,bhwk,k->bhwc", patches, kernel, weight.reshape(-1),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        out = jnp.einsum(
+            "bhwkc,bhwk,kco->bhwo", patches, kernel, weight,
+            preferred_element_type=jnp.float32,
+        )
     if bias is not None:
         out = out + bias
     return out
@@ -152,9 +318,20 @@ def pacconv_transpose2d(
 
 
 def pacpool2d(
-    x: jax.Array, kernel: jax.Array, ksize: int, dilation: int = 1
+    x: jax.Array,
+    kernel: jax.Array,
+    ksize: int,
+    dilation: int = 1,
+    stride: int = 1,
+    padding: Optional[int] = None,
 ) -> jax.Array:
     """Kernel-weighted window sum per channel (reference:
-    core/pac_modules.py:481-489, stride 1)."""
-    patches = extract_patches(x, ksize, dilation)
+    core/pac_modules.py:475-494 native). ``kernel`` is (B, H', W', k*k)
+    (shared across channels) or (B, H', W', k*k, C) (channel-wise).
+    ``padding=None`` keeps the historical 'same' default."""
+    pad = None if padding is None else (padding, padding)
+    patches = extract_patches(x, ksize, dilation, pad, pad)
+    patches = patches[:, ::stride, ::stride]
+    if kernel.ndim == 5:
+        return jnp.einsum("bhwkc,bhwkc->bhwc", patches, kernel)
     return jnp.einsum("bhwkc,bhwk->bhwc", patches, kernel)

@@ -201,3 +201,45 @@ def test_train_step_sharded_matches_single():
     l2 = jax.tree.leaves(s2.params)
     for a, b in zip(l1, l2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+@pytest.mark.slow
+def test_flagship_train_step_sharded_matches_single():
+    """The flagship raft_nc_dbl (NCUP upsampler, BN-sintel config) under a
+    (2 data x 2 spatial) mesh must agree with the unsharded step. This is
+    the component most likely to shard badly: the full-res NConv U-Net runs
+    inside the scan body, so spatial sharding pushes halo exchanges through
+    zero-stuff scatter, conf-argmax pooling, and the NConv chain
+    (reference equivalent being replaced: train.py:169-175)."""
+    from raft_ncup_tpu.config import flagship_config
+
+    mcfg = flagship_config(dataset="sintel")
+    # 64x64: H/8 = 8 keeps all four correlation-pyramid levels non-empty
+    # (smaller inputs are out-of-spec — the reference's smallest crop is
+    # 288px, train_raft_nc_kitti.sh:20).
+    tcfg = TrainConfig(
+        stage="sintel", lr=1e-4, num_steps=50, batch_size=2,
+        image_size=(64, 64), iters=2,
+    )
+    model, state0 = create_train_state(jax.random.key(0), mcfg, tcfg)
+    batch = _synthetic_batch(np.random.default_rng(3), 2, 64, 64)
+    rngk = jax.random.key(4)
+
+    step_single = make_train_step(model, tcfg)
+    s1, m1 = step_single(state0, batch, rngk)
+
+    mesh = make_mesh(data=2, spatial=2)
+    model2, state2 = create_train_state(jax.random.key(0), mcfg, tcfg)
+    step_sharded = make_train_step(model2, tcfg, mesh=mesh)
+    s2, m2 = step_sharded(state2, batch, rngk)
+
+    np.testing.assert_allclose(
+        float(m1["loss"]), float(m2["loss"]), rtol=1e-4
+    )
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+    # BN stays frozen on the sintel stage in both strategies.
+    for a, b in zip(
+        jax.tree.leaves(s1.batch_stats), jax.tree.leaves(s2.batch_stats)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
