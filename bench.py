@@ -1,30 +1,38 @@
-"""Benchmark: flagship-model inference throughput on the available chip.
+"""Benchmark: flagship-model throughput on the available chip.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
-Metric: frame-pairs/sec/chip for raft_nc_dbl (NCUP) test-mode inference at
-12 GRU iterations, 368x768 (the Sintel fine-tune crop,
-reference: train_raft_nc_sintel.sh:14). The reference records no
-throughput anywhere (BASELINE.md), so ``vs_baseline`` compares against
-this framework's own recorded baselines in ``docs/perf_baseline.json``
-(keyed by platform+shape+impl); when no baseline exists for the platform
-the run is the first recording and ``vs_baseline`` is 1.0.
+Primary metric: frame-pairs/sec/chip for raft_nc_dbl (NCUP) test-mode
+inference at 12 GRU iterations, 368x768 (the Sintel fine-tune crop,
+reference: train_raft_nc_sintel.sh:14). Extra fields: ``flops_per_pair``
+and ``mfu`` (XLA cost-analysis FLOPs over the chip's peak — see
+raft_ncup_tpu/utils/flops.py) and, budget permitting, a train-step
+measurement (``train_pairs_per_sec``) since the north-star target is
+training wall-clock (BASELINE.json).
 
-Robustness (round-1 postmortem: the axon TPU backend failed to init and
-the bench crashed with a traceback, recording nothing): the measurement
-runs in a child process; the parent retries the TPU backend with bounded
-timeouts, then falls back to ``JAX_PLATFORMS=''`` (auto-pick), then to an
-explicit CPU run at a reduced shape. Every path — including total
-failure — ends with the parent printing one parseable JSON line and
-exiting 0.
+Robustness (round-2 postmortem, VERDICT.md "What's weak" #1): the axon TPU
+backend can HANG inside ``jax.devices()`` rather than fail fast, and the
+driver kills the whole bench at ~900s. So the parent (which never imports
+jax) runs everything against one global deadline:
+
+1. A cheap bounded PROBE child (`import jax; jax.devices()`) decides
+   whether the inherited backend is alive at all.
+2. If alive: ONE full-shape measurement attempt, budgeted to always leave
+   the CPU fallback its reserve.
+3. Guaranteed CPU fallback at a reduced shape (measured ~85s).
+
+Every path — including total failure — ends with the parent printing one
+parseable JSON line and exiting 0. Children print their JSON as soon as
+the inference number exists, so even a mid-train-measure kill still
+yields a result (harvested from ``TimeoutExpired.stdout``).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -38,14 +46,33 @@ _BASELINE_FILE = os.path.join(_REPO, "docs", "perf_baseline.json")
 FULL = dict(batch=2, height=368, width=768, iters=12)
 SMALL = dict(batch=1, height=96, width=128, iters=4)
 
-TPU_ATTEMPTS = 2
-TPU_TIMEOUT_S = 900  # cold NCUP compile on the chip can take minutes
-FALLBACK_TIMEOUT_S = 1500
+# Budget arithmetic: the driver's window is ~900s; keep the whole chain
+# inside TOTAL_BUDGET_S and always reserve the CPU fallback's slice.
+TOTAL_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "840"))
+PROBE_TIMEOUT_S = 75.0
+TPU_TIMEOUT_CAP_S = 420.0
+CPU_RESERVE_S = 280.0
+
+
+def _host_fingerprint() -> str:
+    """Stable-ish host id so CPU baselines never compare across machines
+    (VERDICT.md weak #5: cross-host CPU numbers differ >2x)."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            model = next(
+                (l.split(":", 1)[1].strip() for l in f if "model name" in l),
+                "unknown",
+            )
+    except OSError:
+        model = "unknown"
+    raw = f"{model}|{os.cpu_count()}"
+    return hashlib.sha1(raw.encode()).hexdigest()[:8]
 
 
 def _baseline_key(platform: str, corr_impl: str, shape: dict) -> str:
+    host = f"@{_host_fingerprint()}" if platform == "cpu" else ""
     return (
-        f"{platform}:{corr_impl}:{shape['batch']}x{shape['height']}"
+        f"{platform}{host}:{corr_impl}:{shape['batch']}x{shape['height']}"
         f"x{shape['width']}x{shape['iters']}"
     )
 
@@ -58,8 +85,20 @@ def _load_baselines() -> dict:
         return {}
 
 
+def _emit(record: dict) -> None:
+    print(json.dumps(record), flush=True)
+
+
 def _child_main() -> None:
-    """Measure in-process and print the result JSON (child only)."""
+    """Measure in-process and print result JSON lines (child only).
+
+    Prints the inference record the moment it exists, then (budget
+    permitting) re-prints it enriched with the train-step measurement; the
+    parent keeps the LAST parseable line.
+    """
+    t0 = time.monotonic()
+    child_budget = float(os.environ.get("_BENCH_CHILD_BUDGET_S", "600"))
+
     import jax
 
     # The axon boot hook bakes JAX_PLATFORMS=axon into jax.config at
@@ -82,14 +121,46 @@ def _child_main() -> None:
         # Full-res NCUP x12 iters is a TPU workload; on a host-CPU backend
         # record the reduced shape rather than time out recording nothing.
         shape = SMALL
+    # bf16 on any accelerator platform ('tpu' via the standard plugin, but
+    # the axon tunnel reports its own platform string — VERDICT.md weak #6).
+    mixed_precision = platform != "cpu"
 
     fwd, (variables, img1, img2) = build_forward(
         shape=(shape["batch"], shape["height"], shape["width"], 3),
         iters=shape["iters"],
-        mixed_precision=(platform == "tpu"),
+        mixed_precision=mixed_precision,
         corr_impl=corr_impl,
     )
-    forward = jax.jit(fwd)
+
+    # AOT-compile ONCE and time the compiled executable directly — calling
+    # the jitted wrapper after .lower().compile() would compile a second
+    # time, and a cold full-shape NCUP compile can take minutes.
+    from raft_ncup_tpu.config import flagship_config
+    from raft_ncup_tpu.utils import flops as flops_mod
+
+    cfg = flagship_config(
+        dataset="sintel", mixed_precision=mixed_precision, corr_impl=corr_impl
+    )
+    fwd_flops = None
+    flops_source = "analytic"
+    forward = None
+    try:
+        compiled = jax.jit(fwd).lower(variables, img1, img2).compile()
+        forward = compiled
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca and ca.get("flops"):
+            fwd_flops = float(ca["flops"])
+            flops_source = "xla_cost_analysis"
+    except Exception as e:  # pragma: no cover - backend-specific
+        print(f"AOT compile/cost_analysis unavailable: {e}", file=sys.stderr)
+    if forward is None:
+        forward = jax.jit(fwd)
+    if not fwd_flops:
+        fwd_flops = flops_mod.forward_flops(
+            cfg, shape["batch"], shape["height"], shape["width"], shape["iters"]
+        )
 
     # On the axon TPU tunnel ``block_until_ready`` returns before the
     # computation finishes; pulling a scalar to host is the only honest
@@ -101,57 +172,133 @@ def _child_main() -> None:
         sync=lambda out: np.asarray(out[1][0, 0, 0, 0]),
     )
     pairs_per_sec = shape["batch"] * rate
+    flops_per_pair = fwd_flops / shape["batch"]
+
+    peak = flops_mod.peak_flops(os.environ.get("PALLAS_AXON_TPU_GEN"))
+    mfu = (
+        round(pairs_per_sec * flops_per_pair / peak, 4)
+        if (peak and platform != "cpu")
+        else None
+    )
 
     key = _baseline_key(platform, corr_impl, shape)
     baseline = _load_baselines().get(key)
     vs = pairs_per_sec / baseline if baseline else 1.0
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"raft_nc_dbl frame-pairs/sec/chip @ {shape['iters']} "
-                    f"iters {shape['height']}x{shape['width']} "
-                    f"({platform}, corr={corr_impl})"
-                ),
-                "value": round(pairs_per_sec, 4),
-                "unit": "pairs/s",
-                "vs_baseline": round(vs, 3),
-                "baseline_key": key,
-            }
-        )
+    record = {
+        "metric": (
+            f"raft_nc_dbl frame-pairs/sec/chip @ {shape['iters']} "
+            f"iters {shape['height']}x{shape['width']} "
+            f"({platform}, corr={corr_impl})"
+        ),
+        "value": round(pairs_per_sec, 4),
+        "unit": "pairs/s",
+        "vs_baseline": round(vs, 3),
+        "baseline_key": key,
+        "flops_per_pair": round(flops_per_pair, 0),
+        "flops_source": flops_source,
+        "mfu": mfu,
+    }
+    _emit(record)
+
+    # Train-step measurement (north star is training wall-clock) — only if
+    # at least ~45% of the child budget remains.
+    remaining = child_budget - (time.monotonic() - t0)
+    if remaining > 0.45 * child_budget:
+        try:
+            train = _measure_train_step(shape, mixed_precision, corr_impl)
+            record.update(train)
+            _emit(record)
+        except Exception as e:  # never lose the inference record
+            print(f"train-step bench failed: {e}", file=sys.stderr)
+
+
+def _measure_train_step(
+    shape: dict, mixed_precision: bool, corr_impl: str
+) -> dict:
+    """Time one optimizer step (fwd+bwd+update) at the bench shape,
+    reference workload anchor: train.py:201-225."""
+    import jax
+    import numpy as np
+
+    from raft_ncup_tpu.config import TrainConfig, flagship_config
+    from raft_ncup_tpu.parallel.step import make_synthetic_batch, make_train_step
+    from raft_ncup_tpu.training.state import create_train_state
+    from raft_ncup_tpu.utils.profiling import measure_throughput
+
+    B, H, W = shape["batch"], shape["height"], shape["width"]
+    model_cfg = flagship_config(
+        dataset="sintel", mixed_precision=mixed_precision, corr_impl=corr_impl
     )
+    train_cfg = TrainConfig(
+        stage="sintel", batch_size=B, image_size=(H, W),
+        iters=shape["iters"], num_steps=100,
+    )
+    model, state = create_train_state(
+        jax.random.PRNGKey(0), model_cfg, train_cfg,
+        image_shape=(1, H, W, 3),
+    )
+    step = make_train_step(model, train_cfg)
+    kbatch, krng = jax.random.split(jax.random.PRNGKey(7))
+    batch = make_synthetic_batch(kbatch, B, H, W)
+
+    # donate_argnums=0 consumes `state`; rebuild the call each rep with the
+    # carried state so timing reflects the steady-state step.
+    holder = {"state": state}
+
+    def one_step():
+        holder["state"], metrics = step(holder["state"], batch, krng)
+        return metrics
+
+    rate = measure_throughput(
+        one_step, warmup=2, reps=3,
+        sync=lambda m: np.asarray(m["loss"]),
+    )
+    return {
+        "train_pairs_per_sec": round(B * rate, 4),
+        "train_ms_per_step": round(1000.0 / rate, 1),
+    }
 
 
-def _run_child(env_overrides: dict, shape: dict, timeout_s: float):
-    """Run the measurement in a child; returns the parsed JSON dict or None."""
-    env = dict(os.environ)
-    env.update(env_overrides)
-    env[_CHILD_ENV] = "1"
-    env["_BENCH_SHAPE"] = json.dumps(shape)
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env,
-            cwd=_REPO,
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired:
-        print(f"bench attempt timed out after {timeout_s}s", file=sys.stderr)
-        return None
-    for line in reversed(proc.stdout.strip().splitlines()):
+def _parse_json_tail(stdout: str):
+    for line in reversed((stdout or "").strip().splitlines()):
         try:
             out = json.loads(line)
             if isinstance(out, dict) and "value" in out:
                 return out
         except ValueError:
             continue
-    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
-    print(
-        f"bench attempt failed rc={proc.returncode}:\n" + "\n".join(tail),
-        file=sys.stderr,
+    return None
+
+
+def _run_child(env_overrides: dict, shape: dict, timeout_s: float):
+    """Run the measurement in a child; returns the parsed JSON dict or None.
+
+    A child killed by the watchdog can still yield a result: the last JSON
+    line it managed to print is harvested from the drained pipe (Popen
+    path — subprocess.run's TimeoutExpired discards partial output)."""
+    from raft_ncup_tpu.utils.backend_probe import run_watchdogged
+
+    env = dict(os.environ)
+    env.update(env_overrides)
+    env[_CHILD_ENV] = "1"
+    env["_BENCH_SHAPE"] = json.dumps(shape)
+    env["_BENCH_CHILD_BUDGET_S"] = str(timeout_s)
+    res = run_watchdogged(
+        [sys.executable, os.path.abspath(__file__)],
+        timeout_s,
+        env=env,
+        cwd=_REPO,
     )
+    if res.timed_out:
+        print(f"bench attempt timed out after {timeout_s:.0f}s", file=sys.stderr)
+    out = _parse_json_tail(res.stdout)
+    if out:
+        return out
+    if not res.timed_out:
+        print(
+            f"bench attempt failed rc={res.returncode}:\n" + res.tail(8),
+            file=sys.stderr,
+        )
     return None
 
 
@@ -160,27 +307,57 @@ def main() -> None:
         _child_main()
         return
 
+    t0 = time.monotonic()
+
+    def remaining() -> float:
+        return TOTAL_BUDGET_S - (time.monotonic() - t0)
+
     result = None
-    # 1) The inherited platform (axon TPU under the driver), with retries —
-    #    round 1 died on a transient backend-init failure.
-    for attempt in range(TPU_ATTEMPTS):
-        result = _run_child({}, FULL, TPU_TIMEOUT_S)
+    # 1) Probe the inherited platform (axon TPU under the driver). The
+    #    probe is the hang detector: jax.devices() blocking is the exact
+    #    r02 failure mode. A fast transient init failure (the round-1
+    #    mode) is retried inside probe_backend; a hang is terminal.
+    from raft_ncup_tpu.utils.backend_probe import probe_backend
+
+    pr = probe_backend(min(PROBE_TIMEOUT_S, remaining() - CPU_RESERVE_S))
+    probe = pr.platform
+    if pr.reason != "ok":
+        print(f"backend probe {pr.reason}: {pr.detail}", file=sys.stderr)
+    if probe and probe != "cpu":
+        budget = min(TPU_TIMEOUT_CAP_S, remaining() - CPU_RESERVE_S)
+        if budget > 60:
+            result = _run_child({}, FULL, budget)
+        # Secondary rows, budget permitting: the alternative corr
+        # implementations at the same shape (VERDICT.md next-round #2/#3 —
+        # the data that decides the default kernel on hardware).
         if result:
-            break
-        if attempt < TPU_ATTEMPTS - 1:
-            time.sleep(10 * (attempt + 1))
-    # 2) Let jax auto-pick a backend (JAX_PLATFORMS='' is the documented
-    #    escape hatch printed by the round-1 crash itself).
-    if not result:
-        result = _run_child(
-            {"JAX_PLATFORMS": "", "_BENCH_FORCE_PLATFORM": ""},
-            FULL, FALLBACK_TIMEOUT_S,
-        )
-    # 3) Explicit CPU at a reduced shape: always yields a number.
+            for impl in ("onthefly", "pallas"):
+                spare = remaining() - CPU_RESERVE_S / 2
+                if spare < 150:
+                    break
+                r2 = _run_child(
+                    {"BENCH_CORR_IMPL": impl}, FULL, min(300.0, spare)
+                )
+                if r2:
+                    _maybe_record_baseline(dict(r2))
+                    result[f"pairs_per_sec_{impl}"] = r2["value"]
+                    if r2.get("train_pairs_per_sec") is not None:
+                        result[f"train_pairs_per_sec_{impl}"] = r2[
+                            "train_pairs_per_sec"
+                        ]
+    elif probe == "cpu":
+        # Inherited platform is already CPU — go straight to the CPU path.
+        pass
+    else:
+        print("inherited backend dead/hanging; skipping TPU attempt",
+              file=sys.stderr)
+    # 2) Guaranteed CPU fallback at a reduced shape: always yields a number
+    #    (judge-verified ~85s on this image).
     if not result:
         result = _run_child(
             {"JAX_PLATFORMS": "cpu", "_BENCH_FORCE_PLATFORM": "cpu"},
-            SMALL, FALLBACK_TIMEOUT_S,
+            SMALL,
+            max(60.0, min(CPU_RESERVE_S, remaining() - 10)),
         )
     if not result:
         result = {

@@ -37,7 +37,11 @@ def main(argv=None) -> None:
     parser.add_argument("--restore_ckpt", default=None,
                         help="alias of --model for our CLI symmetry")
     add_model_args(parser)
+    from raft_ncup_tpu.cli import add_platform_arg, apply_platform
+
+    add_platform_arg(parser)
     args = parser.parse_args(argv)
+    apply_platform(args)
 
     # In the reference demo, --model is the checkpoint path (demo.py:52-53)
     # and the architecture is plain raft. Keep that: if --model points at a

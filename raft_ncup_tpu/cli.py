@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import ast
+import os
 from typing import Optional, Sequence
 
 from raft_ncup_tpu.config import (
@@ -101,6 +102,26 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
                         default=(3, 3, 1))
     parser.add_argument("--weights_est_net_dilation", type=str2intlist,
                         default=(1, 1, 1))
+
+
+def add_platform_arg(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--platform", default=os.environ.get("RAFT_NCUP_PLATFORM"),
+        help="force the jax platform (e.g. 'cpu', 'tpu'). The container's "
+        "boot hook bakes its accelerator platform into jax.config at "
+        "interpreter start — env JAX_PLATFORMS alone cannot override it, "
+        "and a wedged accelerator backend hangs inside jax.devices() — so "
+        "this is applied via jax.config.update before any device use. "
+        "Env fallback: RAFT_NCUP_PLATFORM.",
+    )
+
+
+def apply_platform(args: argparse.Namespace) -> None:
+    if getattr(args, "platform", None):
+        os.environ["JAX_PLATFORMS"] = args.platform
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
 
 def add_data_args(parser: argparse.ArgumentParser) -> None:
@@ -249,6 +270,7 @@ def build_train_parser() -> argparse.ArgumentParser:
     add_train_args(parser)
     add_model_args(parser)
     add_data_args(parser)
+    add_platform_arg(parser)
     return parser
 
 
@@ -267,16 +289,19 @@ def build_eval_parser() -> argparse.ArgumentParser:
     parser.add_argument("--output_path", default=None)
     add_model_args(parser)
     add_data_args(parser)
+    add_platform_arg(parser)
     return parser
 
 
 def parse_train(argv: Optional[Sequence[str]] = None):
     args = build_train_parser().parse_args(argv)
+    apply_platform(args)
     model_cfg = model_config_from_args(args, dataset=args.stage)
     return args, model_cfg, train_config_from_args(args), data_config_from_args(args)
 
 
 def parse_eval(argv: Optional[Sequence[str]] = None):
     args = build_eval_parser().parse_args(argv)
+    apply_platform(args)
     model_cfg = model_config_from_args(args, dataset=args.dataset)
     return args, model_cfg, data_config_from_args(args)

@@ -71,7 +71,9 @@ class FlowLoader:
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.seed = seed
-        self.num_workers = num_workers
+        # 0 means "no parallelism" (torch DataLoader semantics); the
+        # thread-pool producer still needs one worker thread.
+        self.num_workers = max(1, num_workers)
         self.prefetch = prefetch
         self.shard_index = shard_index
         self.num_shards = num_shards

@@ -147,6 +147,8 @@ class RAFT:
         rngs: Optional[dict] = None,
         remat: bool = True,
         mutable: bool = False,
+        mesh=None,
+        spatial_axis: str = "spatial",
     ):
         """Estimate optical flow between a pair of NHWC image batches.
 
@@ -154,6 +156,15 @@ class RAFT:
         predictions (iters, B, H, W, 2); (test_mode) the tuple
         ``(flow_lowres, flow_up)``. With ``mutable=True`` additionally
         returns the updated batch_stats as a second element.
+
+        ``mesh``/``spatial_axis``: when running under a (data x spatial)
+        SPMD mesh, the on-the-fly correlation lookup is wrapped in
+        ``jax.shard_map`` over the spatial axis — queries stay row-sharded
+        while fmap2 is replicated (33 MB at 1/8 res of 1080p). Left to the
+        GSPMD partitioner, the lookup's scan-over-row-chunks structure
+        partitions pathologically (6x the single-device temp memory,
+        measured in tests/test_highres.py); the explicit map makes spatial
+        sharding actually reduce per-device memory.
         """
         cfg = self.cfg
         if image1.shape[1] % 8 or image1.shape[2] % 8:
@@ -202,33 +213,40 @@ class RAFT:
                 return corr_lookup(pyramid, coords, radius)
 
         elif cfg.corr_impl == "onthefly":
-
-            def corr_fn(coords):
-                return corr_lookup_onthefly(
-                    fmap1, fmap2, coords, radius, cfg.corr_levels
-                )
-
-        elif cfg.corr_impl == "pallas":
-            try:
-                from raft_ncup_tpu.ops.corr_pallas import (
-                    corr_lookup_pallas,
-                    fits_vmem,
-                )
-            except ImportError as e:
-                raise NotImplementedError(
-                    "corr_impl='pallas' requires raft_ncup_tpu.ops.corr_pallas"
-                ) from e
-
-            # The kernel keeps the whole fmap2 level resident in VMEM;
-            # shapes past the budget (1080p-class) take the equivalent
-            # XLA on-the-fly path instead (shapes are static at trace
-            # time, so this is a compile-time choice).
-            if fits_vmem(fmap2.shape[1], fmap2.shape[2], fmap2.shape[3], radius):
+            n_spatial = (
+                mesh.shape.get(spatial_axis, 1) if mesh is not None else 1
+            )
+            n_data = mesh.shape.get("data", 1) if mesh is not None else 1
+            shardable = (
+                n_spatial > 1
+                and "data" in (mesh.shape if mesh is not None else {})
+                and fmap1.shape[1] % n_spatial == 0
+                and fmap1.shape[0] % n_data == 0
+            )
+            if shardable:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
 
                 def corr_fn(coords):
-                    return corr_lookup_pallas(
-                        fmap1, fmap2, coords, radius, cfg.corr_levels
+                    f2r = jax.lax.with_sharding_constraint(
+                        fmap2, NamedSharding(mesh, P())
                     )
+
+                    def local(f1_loc, f2_full, c_loc):
+                        return corr_lookup_onthefly(
+                            f1_loc, f2_full, c_loc, radius, cfg.corr_levels
+                        )
+
+                    return jax.shard_map(
+                        local,
+                        mesh=mesh,
+                        in_specs=(
+                            P("data", spatial_axis),
+                            P(),
+                            P("data", spatial_axis),
+                        ),
+                        out_specs=P("data", spatial_axis),
+                    )(fmap1, f2r, coords)
 
             else:
 
@@ -236,6 +254,23 @@ class RAFT:
                     return corr_lookup_onthefly(
                         fmap1, fmap2, coords, radius, cfg.corr_levels
                     )
+
+        elif cfg.corr_impl == "pallas":
+            try:
+                from raft_ncup_tpu.ops.corr_pallas import corr_lookup_pallas
+            except ImportError as e:
+                raise NotImplementedError(
+                    "corr_impl='pallas' requires raft_ncup_tpu.ops.corr_pallas"
+                ) from e
+
+            # Dispatch is per pyramid level inside the op: levels whose
+            # padded slab fits the VMEM budget take the kernel, the rest
+            # (1080p level 0) take the XLA on-the-fly path. Shapes are
+            # static at trace time, so this is a compile-time choice.
+            def corr_fn(coords):
+                return corr_lookup_pallas(
+                    fmap1, fmap2, coords, radius, cfg.corr_levels
+                )
 
         else:
             raise ValueError(f"unknown corr_impl: {cfg.corr_impl!r}")

@@ -135,6 +135,7 @@ def corr_lookup_onthefly(
     radius: int,
     num_levels: int = 4,
     row_chunk: int = 8,
+    levels: Sequence[int] | None = None,
 ) -> jax.Array:
     """Windowed correlation lookup without materializing the volume.
 
@@ -147,10 +148,14 @@ def corr_lookup_onthefly(
       coords: (B, H, W, 2).
       row_chunk: query rows processed per scan step (H % row_chunk may be
         nonzero; handled by padding).
+      levels: pyramid level indices to compute (default: all
+        ``num_levels``); the Pallas dispatcher uses this to source only
+        the levels whose slab exceeds its VMEM budget.
     """
     B, H, W, C = fmap1.shape
     K = 2 * radius + 1
     scale = 1.0 / math.sqrt(C)
+    level_ids = tuple(range(num_levels)) if levels is None else tuple(levels)
     f2_levels = _pool_fmap_pyramid(fmap2.astype(jnp.float32), num_levels)
     f1 = fmap1.astype(jnp.float32)
     delta = _delta_window(radius)
@@ -166,7 +171,7 @@ def corr_lookup_onthefly(
     def chunk_fn(carry, xs):
         f1_chunk, coords_chunk = xs  # (B, rc, W, C), (B, rc, W, 2)
         per_level = []
-        for lvl in range(num_levels):
+        for lvl in level_ids:
             centroid = coords_chunk[:, :, :, None, None, :] / (2**lvl)
             taps = centroid + delta[None, None, None]  # (B, rc, W, K, K, 2)
             sampled = grid_sample(f2_levels[lvl], taps)  # (B, rc, W, K, K, C)

@@ -66,6 +66,7 @@ def make_train_step(
             freeze_bn=freeze_bn,
             rngs={"dropout": rng} if model.cfg.dropout > 0 else None,
             mutable=True,
+            mesh=mesh,
         )
         loss, metrics = sequence_loss(
             preds, batch["flow"], batch["valid"], cfg.gamma, cfg.max_flow
@@ -93,13 +94,27 @@ def make_train_step(
     )
 
 
+def make_synthetic_batch(rng: jax.Array, batch: int, height: int, width: int):
+    """Random (image1, image2, flow, valid) batch in the train-step's
+    contract — shared by the bench's train-step measurement and the
+    driver's multichip dryrun so both exercise the same workload."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    B, H, W = batch, height, width
+    return {
+        "image1": jax.random.uniform(k1, (B, H, W, 3), jnp.float32, 0, 255),
+        "image2": jax.random.uniform(k2, (B, H, W, 3), jnp.float32, 0, 255),
+        "flow": jax.random.normal(k3, (B, H, W, 2), jnp.float32),
+        "valid": jnp.ones((B, H, W), jnp.float32),
+    }
+
+
 def make_eval_step(model: RAFT, iters: int, mesh: Optional[Mesh] = None):
     """Returns ``eval_step(variables, image1, image2) -> (flow_lr, flow_up)``
     (test-mode forward)."""
 
     def step(variables, image1, image2):
         return model.apply(
-            variables, image1, image2, iters=iters, test_mode=True
+            variables, image1, image2, iters=iters, test_mode=True, mesh=mesh
         )
 
     if mesh is None:

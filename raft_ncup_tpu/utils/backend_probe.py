@@ -1,0 +1,110 @@
+"""Bounded, hang-proof JAX backend liveness probe.
+
+The inherited axon TPU backend can HANG inside ``jax.devices()`` rather
+than fail fast (round-2 postmortem, VERDICT.md), so any code that needs
+to know "is there a live accelerator?" must ask in a watchdogged child
+process, never in-process. Shared by bench.py and tests_tpu/conftest.py
+so the postmortem-driven details (config-vs-env forcing, timeout
+semantics, PLATFORM= parsing) live in exactly one place.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from typing import NamedTuple, Optional
+
+
+class ChildResult(NamedTuple):
+    returncode: Optional[int]  # None when killed by the watchdog
+    stdout: str
+    stderr: str
+    timed_out: bool
+
+    def tail(self, n: int = 12) -> str:
+        """Last ``n`` lines of the child's combined output (stdout then
+        stderr) for diagnostics — neither stream is dropped."""
+        combined = "\n".join(s for s in (self.stdout, self.stderr) if s)
+        return "\n".join(combined.strip().splitlines()[-n:])
+
+
+def run_watchdogged(
+    cmd: list[str],
+    timeout_s: float,
+    env: Optional[dict] = None,
+    cwd: Optional[str] = None,
+) -> ChildResult:
+    """``subprocess.run(capture_output=True, timeout=...)`` loses the
+    child's partial output on timeout (POSIX ``TimeoutExpired.stdout`` is
+    None — verified on this interpreter), which defeats harvest-on-kill
+    designs. This Popen-based variant kills the child on expiry and then
+    drains the pipes, so whatever the child printed before the watchdog
+    fired is preserved."""
+    proc = subprocess.Popen(
+        cmd,
+        env=env,
+        cwd=cwd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        return ChildResult(proc.returncode, out or "", err or "", False)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            out, err = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:  # pragma: no cover - wedged pipes
+            out, err = "", ""
+        return ChildResult(None, out or "", err or "", True)
+
+
+class ProbeResult(NamedTuple):
+    platform: Optional[str]  # e.g. 'tpu', 'axon', 'cpu'; None when dead
+    reason: str  # 'ok' | 'hung' | 'failed'
+    detail: str = ""
+
+
+_PROBE_CODE = (
+    "import os, jax\n"
+    "p = os.environ.get('_BENCH_FORCE_PLATFORM')\n"
+    "if p is not None: jax.config.update('jax_platforms', p)\n"
+    "print('PLATFORM=' + jax.devices()[0].platform)\n"
+)
+
+
+def probe_backend(
+    timeout_s: float,
+    env: Optional[dict] = None,
+    retries_on_fast_failure: int = 1,
+) -> ProbeResult:
+    """Import jax + list devices in a child process, bounded by
+    ``timeout_s``. A hang (timeout) is terminal — the backend is wedged
+    and retrying would just burn the budget. A FAST failure (nonzero rc
+    in seconds, e.g. a transient backend-init crash — the round-1 mode)
+    is retried up to ``retries_on_fast_failure`` times.
+    """
+    import os
+    import time
+
+    if timeout_s <= 5:
+        return ProbeResult(None, "failed", "no probe budget")
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    last = ProbeResult(None, "failed")
+    for attempt in range(retries_on_fast_failure + 1):
+        res = run_watchdogged(
+            [sys.executable, "-c", _PROBE_CODE], timeout_s, env=full_env
+        )
+        if res.timed_out:
+            return ProbeResult(None, "hung", f"probe exceeded {timeout_s:.0f}s")
+        for line in res.stdout.splitlines():
+            if line.startswith("PLATFORM="):
+                return ProbeResult(line.split("=", 1)[1].strip(), "ok")
+        tail = "\n".join(res.stderr.strip().splitlines()[-4:])
+        last = ProbeResult(None, "failed", tail)
+        if attempt < retries_on_fast_failure:
+            time.sleep(5)
+    return last

@@ -1,0 +1,59 @@
+"""TPU-gated hardware tests.
+
+This directory deliberately has its own conftest: the main ``tests/``
+suite forces an 8-device virtual CPU platform, while these tests need the
+real chip. The inherited axon TPU backend can HANG inside
+``jax.devices()`` (VERDICT.md r02), so liveness is decided by a bounded
+subprocess probe before any in-process backend init; everything is
+skipped when the probe fails.
+
+Run manually when the chip responds:  python -m pytest tests_tpu/ -v
+"""
+
+import pytest
+
+from raft_ncup_tpu.utils.backend_probe import probe_backend
+
+_PROBE_TIMEOUT_S = 90
+
+
+_THIS_DIR = __file__.rsplit("/", 1)[0]
+
+
+def _in_process_platform():
+    """The platform THIS process will actually use. Under a root-level
+    `pytest` run, tests/conftest.py has already forced jax.config to cpu —
+    probing the chip would then be misleading: these tests would execute
+    on the cpu-forced in-process backend regardless of chip health."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return "cpu"
+    import jax
+
+    return getattr(jax.config, "jax_platforms", None)
+
+
+def pytest_collection_modifyitems(config, items):
+    # Scope to items in THIS directory: a root-level `pytest` run passes
+    # every collected item (including tests/) through subdirectory
+    # conftests, and skipping those would silently disable the CPU suite.
+    tpu_items = [i for i in items if str(i.path).startswith(_THIS_DIR)]
+    if not tpu_items:
+        return
+    if _in_process_platform() == "cpu":
+        reason = (
+            "in-process backend forced to cpu (run `pytest tests_tpu/` "
+            "standalone to target the chip)"
+        )
+    else:
+        pr = probe_backend(_PROBE_TIMEOUT_S)
+        if pr.platform not in (None, "cpu"):
+            return
+        reason = (
+            "no live TPU backend "
+            f"(probe={pr.platform or pr.reason}: {pr.detail})"
+        )
+    marker = pytest.mark.skip(reason=reason)
+    for item in tpu_items:
+        item.add_marker(marker)
