@@ -54,6 +54,7 @@ def nconv2d(
     stride: int = 1,
     groups: int = 1,
     propagate_conf: bool = True,
+    impl: str | None = None,
 ) -> tuple[jax.Array, jax.Array | None]:
     """Normalized convolution with confidence propagation.
 
@@ -62,10 +63,35 @@ def nconv2d(
       weight: (kh, kw, Cin/groups, Cout) HWIO, already non-negative (apply
         :func:`positivity` first).
       bias: (Cout,) or None.
+      impl: 'xla' (two convs + divide) or 'pallas' (fused single-pass
+        kernel, raft_ncup_tpu.ops.nconv_pallas) — default comes from env
+        RAFT_NCUP_NCONV_IMPL ('xla' until hardware timing proves the
+        kernel). 'pallas' silently falls back to 'xla' for unsupported
+        configurations (stride/groups/even kernels) or slabs past the
+        VMEM budget, per shape at trace time.
     Returns:
       (out, conf_out), both (B, H', W', Cout); SAME padding for odd kernels
       (reference pads kernel//2, core/nconv_modules.py:143-144).
     """
+    import os
+
+    impl = impl or os.environ.get("RAFT_NCUP_NCONV_IMPL", "xla")
+    if impl == "pallas":
+        from raft_ncup_tpu.ops import nconv_pallas as npk
+
+        fused_ok = (
+            # Mosaic lowers only on TPU-class backends (the axon tunnel
+            # reports its own platform string; cpu/gpu must fall back).
+            jax.default_backend() not in ("cpu", "gpu", "cuda", "rocm")
+            and npk.supported(weight.shape, stride, groups)
+            and npk.fits_vmem(
+                data.shape[1], data.shape[2], data.shape[3],
+                weight.shape[-1], weight.shape[0],
+            )
+        )
+        if fused_ok:
+            out, conf_out = npk.nconv2d_fused(data, conf, weight, bias, eps)
+            return out, (conf_out if propagate_conf else None)
     kh, kw = weight.shape[0], weight.shape[1]
     pad = ((kh // 2, kh // 2), (kw // 2, kw // 2))
     dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, ("NHWC", "HWIO", "NHWC"))

@@ -1,0 +1,178 @@
+"""Pallas TPU kernel: fused normalized convolution (SURVEY.md §2a(b)).
+
+The XLA path (raft_ncup_tpu.ops.nconv.nconv2d) issues two convolutions —
+``conv(conf * data)`` and ``conv(conf)`` — plus a divide and a scale
+(reference semantics: core/nconv_modules.py:164-199). On TPU these NCUP
+convolutions are pathological for the MXU: 1-2 channels at FULL image
+resolution (XLA pads channels toward 128 lanes, so the arithmetic is
+~1% useful), run 12 times per forward at e.g. 368x768. They are
+memory-bound shift-and-accumulate stencils, not matmuls.
+
+This kernel computes the whole NConv2d in ONE pass over a VMEM-resident
+image slab, as an unrolled shift-multiply-accumulate:
+
+- Both operands (``conf``, ``data*conf``) are zero-padded outside the
+  kernel; every kernel tap is then a STATIC slice of the slab (conv tap
+  offsets are compile-time constants), so the inner loop is pure
+  (8, 128)-tiled VPU work — no gathers, no dynamic indexing, no MXU
+  channel padding waste.
+- The divide, bias, and confidence propagation (``conv(conf)/sum(w)``)
+  fuse into the same pass, so HBM traffic is one read of each operand
+  and one write of each output — the fusion XLA is not guaranteed to
+  find across the conv/divide boundary.
+
+Supported surface = exactly what NCUP uses (stride 1, groups 1, odd
+square kernels, SAME padding); anything else — or a slab past the VMEM
+budget (1080p full-res) — falls back to the XLA composition, per shape,
+at trace time.
+
+Forward-only; ``nconv2d_fused`` wraps the kernel in ``jax.custom_vjp``
+whose backward differentiates the XLA composition (same values =>
+correct gradients), keeping the op trainable.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover - jax builds without pallas-tpu
+    pltpu = None
+
+_VMEM_BYTES = int(os.environ.get("RAFT_NCUP_VMEM_BYTES", str(16 * 1024 * 1024)))
+
+
+def fits_vmem(h: int, w: int, cin: int, cout: int, k: int) -> bool:
+    """Whether one batch element's working set fits the VMEM budget:
+    two padded input slabs + two output slabs + accumulators."""
+    hp, wp = h + k - 1, w + k - 1
+    slabs = 2 * hp * wp * cin + 2 * h * w * cout + 2 * h * w * cout
+    return 4 * slabs <= int(0.75 * _VMEM_BYTES)
+
+
+def supported(weight_shape, stride: int, groups: int) -> bool:
+    kh, kw = weight_shape[0], weight_shape[1]
+    return kh == kw and kh % 2 == 1 and stride == 1 and groups == 1
+
+
+def _kernel(dc_ref, c_ref, w_ref, wsum_ref, bias_ref, out_ref, cout_ref, *,
+            k: int, cin: int, cout: int, eps: float):
+    """One batch element, channel-FIRST so the (H, W) image plane rides
+    the (sublane, lane) vector tiles — channels-last with Cin/Cout of
+    1-2 would waste 126/128 lanes.
+
+    dc_ref/c_ref: (Cin, Hp, Wp) padded slabs of data*conf and conf;
+    w_ref: (k, k, Cin, Cout); wsum_ref/bias_ref: (1, Cout);
+    outputs (Cout, H, W)."""
+    H, W = out_ref.shape[1], out_ref.shape[2]
+    for co in range(cout):
+        acc_x = jnp.zeros((H, W), jnp.float32)
+        acc_c = jnp.zeros((H, W), jnp.float32)
+        for ky in range(k):
+            for kx in range(k):
+                for ci in range(cin):
+                    w = w_ref[ky, kx, ci, co]
+                    acc_x += w * dc_ref[ci, ky : ky + H, kx : kx + W]
+                    acc_c += w * c_ref[ci, ky : ky + H, kx : kx + W]
+        out_ref[co] = acc_x / (acc_c + eps) + bias_ref[0, co]
+        cout_ref[co] = acc_c / wsum_ref[0, co]
+
+
+def _forward(data, conf, weight, bias, eps, interpret):
+    B, H, W, Cin = data.shape
+    k = weight.shape[0]
+    Cout = weight.shape[-1]
+    p = k // 2
+    f32 = jnp.float32
+    # NHWC -> NCHW, pad the image plane.
+    dc = jnp.pad(
+        (data * conf).astype(f32).transpose(0, 3, 1, 2),
+        ((0, 0), (0, 0), (p, p), (p, p)),
+    )
+    cp = jnp.pad(
+        conf.astype(f32).transpose(0, 3, 1, 2),
+        ((0, 0), (0, 0), (p, p), (p, p)),
+    )
+    wsum = weight.sum(axis=(0, 1, 2)).reshape(1, Cout).astype(f32)
+    b = (
+        bias.reshape(1, Cout).astype(f32)
+        if bias is not None
+        else jnp.zeros((1, Cout), f32)
+    )
+    Hp, Wp = H + 2 * p, W + 2 * p
+
+    out, conf_out = pl.pallas_call(
+        functools.partial(_kernel, k=k, cin=Cin, cout=Cout, eps=eps),
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((None, Cin, Hp, Wp), lambda b: (b, 0, 0, 0)),
+            pl.BlockSpec((None, Cin, Hp, Wp), lambda b: (b, 0, 0, 0)),
+            pl.BlockSpec((k, k, Cin, Cout), lambda b: (0, 0, 0, 0)),
+            pl.BlockSpec((1, Cout), lambda b: (0, 0)),
+            pl.BlockSpec((1, Cout), lambda b: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, Cout, H, W), lambda b: (b, 0, 0, 0)),
+            pl.BlockSpec((None, Cout, H, W), lambda b: (b, 0, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Cout, H, W), f32),
+            jax.ShapeDtypeStruct((B, Cout, H, W), f32),
+        ],
+        interpret=interpret,
+    )(dc, cp, weight.astype(f32), wsum, b)
+    # NCHW -> NHWC; restore the input dtype so flipping impl never
+    # changes the op's output dtype (the XLA path preserves it).
+    out = out.transpose(0, 2, 3, 1).astype(data.dtype)
+    conf_out = conf_out.transpose(0, 2, 3, 1).astype(conf.dtype)
+    return out, conf_out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def nconv2d_fused(data, conf, weight, bias, eps: float = 1e-20,
+                  interpret: bool = False):
+    """Fused NConv2d forward: returns ``(out, conf_out)`` equivalent to
+    the XLA composition in :func:`raft_ncup_tpu.ops.nconv.nconv2d`
+    (stride 1, groups 1, odd square kernel) up to float associativity.
+
+    ``bias`` may be None. Caller is responsible for gating via
+    :func:`supported` and :func:`fits_vmem`.
+    """
+    return _forward(data, conf, weight, bias, eps, interpret)
+
+
+def _reference(data, conf, weight, bias, eps):
+    from raft_ncup_tpu.ops.nconv import nconv2d
+
+    # impl='xla' explicitly: with RAFT_NCUP_NCONV_IMPL=pallas exported the
+    # env default would re-dispatch straight back to the fused kernel and
+    # the backward would recurse without a base case.
+    return nconv2d(data, conf, weight, bias, eps=eps, impl="xla")
+
+
+def _fwd(data, conf, weight, bias, eps, interpret):
+    out = _forward(data, conf, weight, bias, eps, interpret)
+    return out, (data, conf, weight, bias)
+
+
+def _bwd(eps, interpret, res, g):
+    data, conf, weight, bias = res
+    if bias is None:
+        _, vjp = jax.vjp(
+            lambda d, c, w: _reference(d, c, w, None, eps), data, conf, weight
+        )
+        gd, gc, gw = vjp(g)
+        return gd, gc, gw, None
+    _, vjp = jax.vjp(
+        lambda d, c, w, b: _reference(d, c, w, b, eps), data, conf, weight, bias
+    )
+    return vjp(g)
+
+
+nconv2d_fused.defvjp(_fwd, _bwd)

@@ -1,8 +1,10 @@
 """Normalized-convolution primitive tests against a torch oracle mirroring
 core/nconv_modules.py:164-199."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import torch
 import torch.nn.functional as F
 
@@ -123,3 +125,72 @@ def test_nconv_gradient_flows():
 
     g = jax.grad(loss_fn)(jnp.full((3, 3, 1, 2), 2.0))
     assert np.isfinite(np.asarray(g)).all()
+
+
+class TestFusedNConvPallas:
+    """Interpret-mode equivalence of the fused Pallas NConv2d
+    (raft_ncup_tpu.ops.nconv_pallas) against the XLA composition."""
+
+    def _setup(self, k=5, cin=1, cout=2, shape=(2, 24, 32)):
+        g = np.random.default_rng(7)
+        B, H, W = shape
+        data = jnp.asarray(g.normal(size=(B, H, W, cin)), jnp.float32)
+        conf = jnp.asarray(g.random((B, H, W, cin)), jnp.float32)
+        weight = positivity(
+            jnp.asarray(g.normal(2.0, 0.5, (k, k, cin, cout)), jnp.float32)
+        )
+        bias = jnp.asarray(g.normal(size=(cout,)), jnp.float32)
+        return data, conf, weight, bias
+
+    @pytest.mark.parametrize("k,cin,cout", [(5, 1, 2), (3, 4, 2), (1, 2, 1)])
+    def test_matches_xla_composition(self, k, cin, cout):
+        from raft_ncup_tpu.ops.nconv_pallas import nconv2d_fused
+
+        data, conf, weight, bias = self._setup(k, cin, cout)
+        ref_out, ref_conf = nconv2d(data, conf, weight, bias)
+        out, conf_out = nconv2d_fused(data, conf, weight, bias, 1e-20, True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref_out), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(conf_out), np.asarray(ref_conf), rtol=1e-5, atol=1e-5
+        )
+
+    def test_no_bias(self):
+        from raft_ncup_tpu.ops.nconv_pallas import nconv2d_fused
+
+        data, conf, weight, _ = self._setup()
+        ref_out, _ = nconv2d(data, conf, weight, None)
+        out, _ = nconv2d_fused(data, conf, weight, None, 1e-20, True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref_out), rtol=1e-5, atol=1e-5
+        )
+
+    def test_gradients_match_xla(self):
+        from raft_ncup_tpu.ops.nconv_pallas import nconv2d_fused
+
+        data, conf, weight, bias = self._setup(k=3, shape=(1, 12, 16))
+
+        def loss_fused(d, c, w, b):
+            out, co = nconv2d_fused(d, c, w, b, 1e-20, True)
+            return (out**2).sum() + (co**2).sum()
+
+        def loss_ref(d, c, w, b):
+            out, co = nconv2d(d, c, w, b)
+            return (out**2).sum() + (co**2).sum()
+
+        gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(data, conf, weight, bias)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(data, conf, weight, bias)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            )
+
+    def test_dispatch_gating(self):
+        from raft_ncup_tpu.ops import nconv_pallas as npk
+
+        assert npk.supported((5, 5, 1, 2), stride=1, groups=1)
+        assert not npk.supported((5, 5, 1, 2), stride=2, groups=1)
+        assert not npk.supported((4, 4, 1, 2), stride=1, groups=1)
+        assert npk.fits_vmem(368, 768, 1, 2, 5)
+        assert not npk.fits_vmem(1088, 1920, 1, 2, 5)
