@@ -108,6 +108,9 @@ def _child_main() -> None:
         jax.config.update(
             "jax_platforms", os.environ["_BENCH_FORCE_PLATFORM"]
         )
+    from __graft_entry__ import enable_compilation_cache
+
+    enable_compilation_cache()
 
     import numpy as np
 
@@ -352,13 +355,23 @@ def main() -> None:
         print("inherited backend dead/hanging; skipping TPU attempt",
               file=sys.stderr)
     # 2) Guaranteed CPU fallback at a reduced shape: always yields a number
-    #    (judge-verified ~85s on this image).
+    #    (judge-verified ~85s on this image). A fast crash can be a
+    #    poisoned XLA compilation cache (AOT machine-feature mismatch can
+    #    SIGILL) — wipe it and retry once.
     if not result:
+        cpu_env = {"JAX_PLATFORMS": "cpu", "_BENCH_FORCE_PLATFORM": "cpu"}
         result = _run_child(
-            {"JAX_PLATFORMS": "cpu", "_BENCH_FORCE_PLATFORM": "cpu"},
-            SMALL,
-            max(60.0, min(CPU_RESERVE_S, remaining() - 10)),
+            cpu_env, SMALL, max(60.0, min(CPU_RESERVE_S, remaining() - 10))
         )
+        if not result:
+            from __graft_entry__ import wipe_compilation_cache_for_retry
+
+            if wipe_compilation_cache_for_retry(remaining() - 10):
+                print("wiped XLA cache, retrying CPU bench cold",
+                      file=sys.stderr)
+                result = _run_child(
+                    cpu_env, SMALL, max(60.0, remaining() - 10)
+                )
     if not result:
         result = {
             "metric": "raft_nc_dbl frame-pairs/sec/chip (no backend available)",

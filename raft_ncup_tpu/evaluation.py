@@ -308,8 +308,36 @@ def create_kitti_submission(
             )
 
 
+def validate_synthetic(
+    model: RAFT, variables: dict, data_cfg: Optional[DataConfig] = None,
+    iters: int = 12, batch_size: int = 4, size_hw: tuple[int, int] = (96, 128),
+    length: int = 32,
+) -> dict:
+    """EPE on a HELD-OUT procedural split (seed distinct from the
+    training fallback's seed=0) so data-free runs (`--synthetic_ok`,
+    `--validation synthetic`) get a genuine generalization signal, not a
+    training-set echo. No reference analogue — the reference always
+    validates on real datasets (evaluate.py:90-182)."""
+    from raft_ncup_tpu.data.synthetic import SyntheticFlowDataset
+
+    dataset = SyntheticFlowDataset(size_hw, length=length, seed=999)
+    fwd = _ShapeCachedForward(model, variables)
+    epe_list = []
+    for group in _uniform_batches(dataset, batch_size):
+        img1 = np.stack([s["image1"] for s in group]).astype(np.float32)
+        img2 = np.stack([s["image2"] for s in group]).astype(np.float32)
+        _, flow_up = fwd(img1, img2, iters)
+        for k, s in enumerate(group):
+            epe = np.sqrt(((np.asarray(flow_up[k]) - s["flow"]) ** 2).sum(-1))
+            epe_list.append(epe.ravel())
+    epe = float(np.concatenate(epe_list).mean())
+    print(f"Validation Synthetic EPE: {epe:f}")
+    return {"synthetic": epe}
+
+
 VALIDATORS = {
     "chairs": validate_chairs,
     "sintel": validate_sintel,
     "kitti": validate_kitti,
+    "synthetic": validate_synthetic,
 }
