@@ -274,7 +274,10 @@ def _parse_json_tail(stdout: str):
 
 
 def _run_child(env_overrides: dict, shape: dict, timeout_s: float):
-    """Run the measurement in a child; returns the parsed JSON dict or None.
+    """Run the measurement in a child; returns ``(record_or_None,
+    crashed)`` — ``crashed`` is True only for a nonzero exit, NOT for a
+    watchdog timeout (the cache-wipe retry must not trigger on timeouts:
+    a partially-warm cache is exactly what makes the retry viable).
 
     A child killed by the watchdog can still yield a result: the last JSON
     line it managed to print is harvested from the drained pipe (Popen
@@ -296,13 +299,13 @@ def _run_child(env_overrides: dict, shape: dict, timeout_s: float):
         print(f"bench attempt timed out after {timeout_s:.0f}s", file=sys.stderr)
     out = _parse_json_tail(res.stdout)
     if out:
-        return out
+        return out, False
     if not res.timed_out:
         print(
             f"bench attempt failed rc={res.returncode}:\n" + res.tail(8),
             file=sys.stderr,
         )
-    return None
+    return None, (not res.timed_out and res.returncode != 0)
 
 
 def main() -> None:
@@ -329,7 +332,7 @@ def main() -> None:
     if probe and probe != "cpu":
         budget = min(TPU_TIMEOUT_CAP_S, remaining() - CPU_RESERVE_S)
         if budget > 60:
-            result = _run_child({}, FULL, budget)
+            result, _ = _run_child({}, FULL, budget)
         # Secondary rows, budget permitting: the alternative corr
         # implementations at the same shape (VERDICT.md next-round #2/#3 —
         # the data that decides the default kernel on hardware).
@@ -338,7 +341,7 @@ def main() -> None:
                 spare = remaining() - CPU_RESERVE_S / 2
                 if spare < 150:
                     break
-                r2 = _run_child(
+                r2, _ = _run_child(
                     {"BENCH_CORR_IMPL": impl}, FULL, min(300.0, spare)
                 )
                 if r2:
@@ -355,21 +358,29 @@ def main() -> None:
         print("inherited backend dead/hanging; skipping TPU attempt",
               file=sys.stderr)
     # 2) Guaranteed CPU fallback at a reduced shape: always yields a number
-    #    (judge-verified ~85s on this image). A fast crash can be a
+    #    (judge-verified ~85s on this image). A fast CRASH can be a
     #    poisoned XLA compilation cache (AOT machine-feature mismatch can
-    #    SIGILL) — wipe it and retry once.
+    #    SIGILL) — wipe it and retry once. A timeout must NOT wipe: the
+    #    partially-warm cache is what makes the retry viable.
     if not result:
         cpu_env = {"JAX_PLATFORMS": "cpu", "_BENCH_FORCE_PLATFORM": "cpu"}
-        result = _run_child(
+        result, crashed = _run_child(
             cpu_env, SMALL, max(60.0, min(CPU_RESERVE_S, remaining() - 10))
         )
-        if not result:
+        if not result and crashed:
             from __graft_entry__ import wipe_compilation_cache_for_retry
 
             if wipe_compilation_cache_for_retry(remaining() - 10):
                 print("wiped XLA cache, retrying CPU bench cold",
                       file=sys.stderr)
-                result = _run_child(
+                result, _ = _run_child(
+                    cpu_env, SMALL, max(60.0, remaining() - 10)
+                )
+        elif not result:
+            # Timed out: retry warm (the first attempt's compile work is
+            # in the cache) if budget allows.
+            if remaining() > 90:
+                result, _ = _run_child(
                     cpu_env, SMALL, max(60.0, remaining() - 10)
                 )
     if not result:

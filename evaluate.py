@@ -57,6 +57,12 @@ def main(argv=None) -> None:
     model = RAFT(model_cfg)
     variables = load_variables(model, model_cfg, args.restore_ckpt)
 
+    mesh = None
+    if args.spatial_parallel > 1:
+        from raft_ncup_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(data=1, spatial=args.spatial_parallel)
+
     if args.submission:
         if args.dataset == "sintel":
             kwargs = {}
@@ -65,7 +71,7 @@ def main(argv=None) -> None:
             create_sintel_submission(
                 model, variables, data_cfg,
                 warm_start=args.warm_start, write_png=args.write_png,
-                **kwargs,
+                mesh=mesh, **kwargs,
             )
         elif args.dataset == "kitti":
             kwargs = {}
@@ -73,13 +79,13 @@ def main(argv=None) -> None:
                 kwargs["output_path"] = args.output_path
             create_kitti_submission(
                 model, variables, data_cfg, write_png=args.write_png,
-                **kwargs,
+                mesh=mesh, **kwargs,
             )
         else:
             raise SystemExit("--submission supports sintel/kitti only")
         return
 
-    results = VALIDATORS[args.dataset](model, variables, data_cfg)
+    results = VALIDATORS[args.dataset](model, variables, data_cfg, mesh=mesh)
     print(results)
 
 

@@ -31,12 +31,32 @@ from raft_ncup_tpu.viz import flow_to_image
 
 
 class _ShapeCachedForward:
-    """jit cache keyed by (padded shape, iters, warm-start presence)."""
+    """jit cache keyed by (padded shape, iters, warm-start presence).
 
-    def __init__(self, model: RAFT, variables: dict):
+    With ``mesh`` set (a (data, spatial) ``jax.sharding.Mesh``), every
+    forward is one SPMD program: images/flow_init sharded over
+    (batch, height), variables and outputs replicated — the driver-level
+    entry to spatially-sharded high-res eval (the corr lookup takes the
+    shard_map path inside the model, models/raft.py)."""
+
+    def __init__(self, model: RAFT, variables: dict, mesh=None):
         self.model = model
         self.variables = variables
+        self.mesh = mesh
         self._fns: dict = {}
+
+    def _jit(self, fn, n_img_args: int):
+        if self.mesh is None:
+            return jax.jit(fn)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(self.mesh, P())
+        img = NamedSharding(self.mesh, P("data", "spatial"))
+        return jax.jit(
+            fn,
+            in_shardings=(repl,) + (img,) * n_img_args,
+            out_shardings=(repl, repl),
+        )
 
     def __call__(
         self,
@@ -47,11 +67,12 @@ class _ShapeCachedForward:
     ):
         key = (image1.shape, iters, flow_init is not None)
         if key not in self._fns:
+            mesh = self.mesh
             if flow_init is None:
 
                 def fn(v, i1, i2):
                     return self.model.apply(
-                        v, i1, i2, iters=iters, test_mode=True
+                        v, i1, i2, iters=iters, test_mode=True, mesh=mesh
                     )
 
             else:
@@ -59,15 +80,24 @@ class _ShapeCachedForward:
                 def fn(v, i1, i2, finit):
                     return self.model.apply(
                         v, i1, i2, iters=iters, flow_init=finit,
-                        test_mode=True,
+                        test_mode=True, mesh=mesh,
                     )
 
-            self._fns[key] = jax.jit(fn)
+            self._fns[key] = self._jit(fn, 2 if flow_init is None else 3)
         args = (jnp.asarray(image1), jnp.asarray(image2))
         if flow_init is not None:
             args += (jnp.asarray(flow_init),)
         flow_lr, flow_up = self._fns[key](self.variables, *args)
         return np.asarray(flow_lr), np.asarray(flow_up)
+
+
+def _pad_divisor(mesh) -> int:
+    """Images must pad so the 1/8-res feature height divides the mesh's
+    spatial axis, else the model's corr lookup cannot take the shard_map
+    path (models/raft.py) and GSPMD partitions it pathologically."""
+    if mesh is None:
+        return 8
+    return 8 * int(mesh.shape.get("spatial", 1))
 
 
 def _pair_arrays(sample: dict) -> tuple[np.ndarray, np.ndarray]:
@@ -123,7 +153,7 @@ def _uniform_batches(dataset, batch_size: int, num_workers: int = 4):
 
 def validate_chairs(
     model: RAFT, variables: dict, data_cfg: Optional[DataConfig] = None,
-    iters: int = 24, batch_size: int = 4,
+    iters: int = 24, batch_size: int = 4, mesh=None,
 ) -> dict:
     """FlyingChairs validation-split EPE (reference: evaluate.py:90-108)."""
     cfg = data_cfg or DataConfig()
@@ -134,7 +164,7 @@ def validate_chairs(
     if len(dataset) == 0:
         print(f"validate_chairs: no data under {cfg.root_chairs}, skipping")
         return {}
-    fwd = _ShapeCachedForward(model, variables)
+    fwd = _ShapeCachedForward(model, variables, mesh=mesh)
     epe_list = []
     for group in _uniform_batches(dataset, batch_size):
         img1 = np.stack([s["image1"] for s in group]).astype(np.float32)
@@ -150,12 +180,12 @@ def validate_chairs(
 
 def validate_sintel(
     model: RAFT, variables: dict, data_cfg: Optional[DataConfig] = None,
-    iters: int = 32, batch_size: int = 2,
+    iters: int = 32, batch_size: int = 2, mesh=None,
 ) -> dict:
     """Sintel train-split clean+final EPE / 1px / 3px / 5px
     (reference: evaluate.py:111-143)."""
     cfg = data_cfg or DataConfig()
-    fwd = _ShapeCachedForward(model, variables)
+    fwd = _ShapeCachedForward(model, variables, mesh=mesh)
     results = {}
     for dstype in ("clean", "final"):
         dataset = ds_mod.MpiSintel(
@@ -171,7 +201,7 @@ def validate_sintel(
         for group in _uniform_batches(dataset, batch_size):
             img1 = np.stack([s["image1"] for s in group]).astype(np.float32)
             img2 = np.stack([s["image2"] for s in group]).astype(np.float32)
-            padder = InputPadder(img1.shape)
+            padder = InputPadder(img1.shape, divisor=_pad_divisor(mesh))
             img1, img2 = padder.pad(img1, img2)
             _, flow_up = fwd(np.asarray(img1), np.asarray(img2), iters)
             flow_b = np.asarray(padder.unpad(jnp.asarray(flow_up)))
@@ -194,7 +224,7 @@ def validate_sintel(
 
 def validate_kitti(
     model: RAFT, variables: dict, data_cfg: Optional[DataConfig] = None,
-    iters: int = 24,
+    iters: int = 24, mesh=None,
 ) -> dict:
     """KITTI-2015 train-split EPE + F1 (reference: evaluate.py:146-182).
     F1 = % of valid pixels with epe > 3 and epe/mag > 0.05."""
@@ -203,11 +233,11 @@ def validate_kitti(
     if len(dataset) == 0:
         print(f"validate_kitti: no data under {cfg.root_kitti}, skipping")
         return {}
-    fwd = _ShapeCachedForward(model, variables)
+    fwd = _ShapeCachedForward(model, variables, mesh=mesh)
     epe_list, out_list = [], []
     for s in _prefetch_samples(dataset):
         img1, img2 = _pair_arrays(s)
-        padder = InputPadder(img1.shape, mode="kitti")
+        padder = InputPadder(img1.shape, mode="kitti", divisor=_pad_divisor(mesh))
         img1, img2 = padder.pad(img1, img2)
         _, flow_up = fwd(np.asarray(img1), np.asarray(img2), iters)
         flow = np.asarray(padder.unpad(jnp.asarray(flow_up))[0])
@@ -232,12 +262,13 @@ def create_sintel_submission(
     warm_start: bool = False,
     output_path: str = "sintel_submission",
     write_png: bool = False,
+    mesh=None,
 ) -> None:
     """Write Sintel leaderboard .flo files (reference: evaluate.py:22-57),
     optionally warm-starting each sequence from the previous frame's
     forward-interpolated low-res flow."""
     cfg = data_cfg or DataConfig()
-    fwd = _ShapeCachedForward(model, variables)
+    fwd = _ShapeCachedForward(model, variables, mesh=mesh)
     for dstype in ("clean", "final"):
         dataset = ds_mod.MpiSintel(
             None, split="test", root=cfg.root_sintel, dstype=dstype
@@ -249,7 +280,7 @@ def create_sintel_submission(
                 flow_prev = None
             img1 = np.asarray(s["image1"], np.float32)[None]
             img2 = np.asarray(s["image2"], np.float32)[None]
-            padder = InputPadder(img1.shape)
+            padder = InputPadder(img1.shape, divisor=_pad_divisor(mesh))
             img1, img2 = padder.pad(img1, img2)
             flow_lr, flow_up = fwd(
                 np.asarray(img1), np.asarray(img2), iters, flow_init=flow_prev
@@ -282,11 +313,12 @@ def create_kitti_submission(
     iters: int = 24,
     output_path: str = "kitti_submission",
     write_png: bool = False,
+    mesh=None,
 ) -> None:
     """Write KITTI leaderboard 16-bit pngs (reference: evaluate.py:60-87)."""
     cfg = data_cfg or DataConfig()
     dataset = ds_mod.KITTI(None, split="testing", root=cfg.root_kitti)
-    fwd = _ShapeCachedForward(model, variables)
+    fwd = _ShapeCachedForward(model, variables, mesh=mesh)
     os.makedirs(output_path, exist_ok=True)
     if write_png:
         os.makedirs(output_path + "_png", exist_ok=True)
@@ -294,7 +326,7 @@ def create_kitti_submission(
         (frame_id,) = s["extra_info"]
         img1 = np.asarray(s["image1"], np.float32)[None]
         img2 = np.asarray(s["image2"], np.float32)[None]
-        padder = InputPadder(img1.shape, mode="kitti")
+        padder = InputPadder(img1.shape, mode="kitti", divisor=_pad_divisor(mesh))
         img1, img2 = padder.pad(img1, img2)
         _, flow_up = fwd(np.asarray(img1), np.asarray(img2), iters)
         flow = np.asarray(padder.unpad(jnp.asarray(flow_up))[0])
@@ -311,7 +343,7 @@ def create_kitti_submission(
 def validate_synthetic(
     model: RAFT, variables: dict, data_cfg: Optional[DataConfig] = None,
     iters: int = 12, batch_size: int = 4, size_hw: tuple[int, int] = (96, 128),
-    length: int = 32,
+    length: int = 32, mesh=None,
 ) -> dict:
     """EPE on a HELD-OUT procedural split (seed distinct from the
     training fallback's seed=0) so data-free runs (`--synthetic_ok`,
@@ -321,7 +353,7 @@ def validate_synthetic(
     from raft_ncup_tpu.data.synthetic import SyntheticFlowDataset
 
     dataset = SyntheticFlowDataset(size_hw, length=length, seed=999)
-    fwd = _ShapeCachedForward(model, variables)
+    fwd = _ShapeCachedForward(model, variables, mesh=mesh)
     epe_list = []
     for group in _uniform_batches(dataset, batch_size):
         img1 = np.stack([s["image1"] for s in group]).astype(np.float32)

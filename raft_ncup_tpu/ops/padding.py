@@ -16,13 +16,20 @@ class InputPadder:
     padding is centered in both modes.
     """
 
-    def __init__(self, dims: tuple[int, ...], mode: str = "sintel"):
-        # dims is NHWC (B, H, W, C) or HWC (H, W, C).
+    def __init__(
+        self, dims: tuple[int, ...], mode: str = "sintel", divisor: int = 8
+    ):
+        # dims is NHWC (B, H, W, C) or HWC (H, W, C). ``divisor`` > 8 is
+        # used by spatially-sharded eval: the 1/8-res feature height must
+        # divide the mesh's spatial axis, so images pad to 8 * spatial
+        # (models/raft.py falls back to the pathological GSPMD partition
+        # of the corr lookup otherwise).
         if len(dims) == 4:
             self.ht, self.wd = dims[1], dims[2]
         else:
             self.ht, self.wd = dims[0], dims[1]
-        pad_ht = (((self.ht // 8) + 1) * 8 - self.ht) % 8
+        d = divisor
+        pad_ht = (((self.ht // d) + 1) * d - self.ht) % d
         pad_wd = (((self.wd // 8) + 1) * 8 - self.wd) % 8
         wpad = (pad_wd // 2, pad_wd - pad_wd // 2)
         if mode == "sintel":

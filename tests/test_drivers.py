@@ -288,3 +288,24 @@ def test_validate_synthetic_heldout():
     )
     assert set(out) == {"synthetic"}
     assert np.isfinite(out["synthetic"])
+
+
+def test_validate_synthetic_spatial_mesh_matches():
+    """The mesh-sharded eval path (evaluate.py --spatial_parallel) must
+    reproduce the single-device validator EPE."""
+    import jax
+
+    from raft_ncup_tpu.config import small_model_config
+    from raft_ncup_tpu.evaluation import validate_synthetic
+    from raft_ncup_tpu.models import get_model
+    from raft_ncup_tpu.parallel.mesh import make_mesh
+
+    model = get_model(
+        small_model_config("raft", dataset="chairs", corr_impl="onthefly")
+    )
+    variables = model.init(jax.random.PRNGKey(0), (1, 32, 48, 3))
+    kwargs = dict(iters=2, batch_size=2, size_hw=(32, 48), length=4)
+    ref = validate_synthetic(model, variables, **kwargs)
+    mesh = make_mesh(data=1, spatial=2, devices=jax.devices()[:2])
+    out = validate_synthetic(model, variables, mesh=mesh, **kwargs)
+    np.testing.assert_allclose(out["synthetic"], ref["synthetic"], rtol=1e-4)

@@ -167,3 +167,17 @@ def test_input_padder_roundtrip(mode):
         tp = [pad_wd // 2, pad_wd - pad_wd // 2, 0, pad_ht]
     theirs = F.pad(t, tp, mode="replicate").permute(0, 2, 3, 1).numpy()
     np.testing.assert_allclose(np.asarray(padded), theirs)
+
+
+def test_input_padder_custom_divisor():
+    """divisor=16 (8 * spatial=2) pads H so the 1/8-res feature height is
+    even — required for the shard_map corr path in spatially-sharded
+    eval; W still pads to 8 only."""
+    import jax.numpy as jnp
+
+    x = jnp.zeros((1, 436, 1024, 3))  # Sintel height
+    padder = InputPadder(x.shape, divisor=16)
+    (y,) = padder.pad(x)
+    assert y.shape[1] % 16 == 0 and (y.shape[1] // 8) % 2 == 0
+    assert y.shape[2] % 8 == 0
+    assert padder.unpad(y).shape == x.shape
