@@ -267,9 +267,17 @@ class RAFT:
             # padded slab fits the VMEM budget take the kernel, the rest
             # (1080p level 0) take the XLA on-the-fly path. Shapes are
             # static at trace time, so this is a compile-time choice.
+            # Mosaic lowers only on TPU-class backends; on the known
+            # non-TPU platforms the kernel runs in interpret mode (slow
+            # but correct) so corr_impl='pallas' works everywhere. This
+            # is a denylist, not `backend == "tpu"`, because TPU-class
+            # plugins report their own platform strings (the axon tunnel
+            # does) and must get the real Mosaic compile.
+            interpret = jax.default_backend() in ("cpu", "gpu", "cuda", "rocm")
+
             def corr_fn(coords):
                 return corr_lookup_pallas(
-                    fmap1, fmap2, coords, radius, cfg.corr_levels
+                    fmap1, fmap2, coords, radius, cfg.corr_levels, interpret
                 )
 
         else:

@@ -160,3 +160,43 @@ def test_onthefly_1080p_executes():
     assert up.shape == (1, H1080, W1080, 2)
     assert lr.shape == (1, H1080 // 8, W1080 // 8, 2)
     assert bool(jnp.isfinite(up).all())
+
+
+@pytest.mark.slow
+def test_train_step_onthefly_spatial_mesh_matches_single():
+    """One optimizer step with corr_impl='onthefly' on a (1 x 2) spatial
+    mesh must reproduce the unsharded step's loss/metrics: the corr
+    lookup's shard_map (replicated fmap2 -> psum'd cotangent) has to be
+    transparent to autodiff."""
+    from raft_ncup_tpu.config import TrainConfig, small_model_config
+    from raft_ncup_tpu.parallel.mesh import make_mesh
+    from raft_ncup_tpu.parallel.step import (
+        make_synthetic_batch,
+        make_train_step,
+    )
+    from raft_ncup_tpu.training.state import create_train_state
+
+    model_cfg = small_model_config(
+        "raft", dataset="chairs", corr_impl="onthefly"
+    )
+    train_cfg = TrainConfig(
+        stage="chairs", batch_size=2, image_size=(32, 32), iters=2,
+        num_steps=10,
+    )
+    batch = make_synthetic_batch(jax.random.PRNGKey(5), 2, 32, 32)
+    rng = jax.random.PRNGKey(6)
+
+    def one_step(mesh):
+        model, state = create_train_state(
+            jax.random.PRNGKey(0), model_cfg, train_cfg,
+            image_shape=(1, 32, 32, 3),
+        )
+        step = make_train_step(model, train_cfg, mesh=mesh)
+        _, metrics = step(state, dict(batch), rng)
+        return {k: float(v) for k, v in metrics.items()}
+
+    ref = one_step(None)
+    mesh = make_mesh(data=1, spatial=2, devices=jax.devices()[:2])
+    out = one_step(mesh)
+    for k in ("loss", "epe", "grad_norm"):
+        np.testing.assert_allclose(out[k], ref[k], rtol=2e-4, err_msg=k)
