@@ -108,7 +108,7 @@ def _child_main() -> None:
         jax.config.update(
             "jax_platforms", os.environ["_BENCH_FORCE_PLATFORM"]
         )
-    from __graft_entry__ import enable_compilation_cache
+    from raft_ncup_tpu.utils.runtime import enable_compilation_cache
 
     enable_compilation_cache()
 
@@ -368,7 +368,9 @@ def main() -> None:
             cpu_env, SMALL, max(60.0, min(CPU_RESERVE_S, remaining() - 10))
         )
         if not result and crashed:
-            from __graft_entry__ import wipe_compilation_cache_for_retry
+            from raft_ncup_tpu.utils.runtime import (
+                wipe_compilation_cache_for_retry,
+            )
 
             if wipe_compilation_cache_for_retry(remaining() - 10):
                 print("wiped XLA cache, retrying CPU bench cold",

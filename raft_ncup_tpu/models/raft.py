@@ -267,13 +267,12 @@ class RAFT:
             # padded slab fits the VMEM budget take the kernel, the rest
             # (1080p level 0) take the XLA on-the-fly path. Shapes are
             # static at trace time, so this is a compile-time choice.
-            # Mosaic lowers only on TPU-class backends; on the known
-            # non-TPU platforms the kernel runs in interpret mode (slow
-            # but correct) so corr_impl='pallas' works everywhere. This
-            # is a denylist, not `backend == "tpu"`, because TPU-class
-            # plugins report their own platform strings (the axon tunnel
-            # does) and must get the real Mosaic compile.
-            interpret = jax.default_backend() in ("cpu", "gpu", "cuda", "rocm")
+            # Mosaic lowers only on TPU-class backends; on non-TPU
+            # platforms the kernel runs in interpret mode (slow but
+            # correct) so corr_impl='pallas' works everywhere.
+            from raft_ncup_tpu.utils.runtime import is_tpu_class_backend
+
+            interpret = not is_tpu_class_backend()
 
             def corr_fn(coords):
                 return corr_lookup_pallas(

@@ -50,7 +50,6 @@ from __future__ import annotations
 
 import functools
 import math
-import os
 
 import jax
 import jax.numpy as jnp
@@ -64,8 +63,8 @@ except ImportError:  # pragma: no cover - CPU-only jax builds
     pltpu = None
     _SMEM = None
 
-# Per-core VMEM capacity. ~16 MiB on current chips (pallas_guide.md).
-_VMEM_BYTES = int(os.environ.get("RAFT_NCUP_VMEM_BYTES", str(16 * 1024 * 1024)))
+from raft_ncup_tpu.utils.runtime import VMEM_BYTES as _VMEM_BYTES
+
 _QUERY_BLOCK = 512
 _GROUP = 8  # queries per vectorized inner step (sublane tile)
 

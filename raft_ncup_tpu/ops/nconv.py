@@ -79,10 +79,11 @@ def nconv2d(
     if impl == "pallas":
         from raft_ncup_tpu.ops import nconv_pallas as npk
 
+        from raft_ncup_tpu.utils.runtime import is_tpu_class_backend
+
         fused_ok = (
-            # Mosaic lowers only on TPU-class backends (the axon tunnel
-            # reports its own platform string; cpu/gpu must fall back).
-            jax.default_backend() not in ("cpu", "gpu", "cuda", "rocm")
+            # Mosaic lowers only on TPU-class backends; cpu/gpu fall back.
+            is_tpu_class_backend()
             and npk.supported(weight.shape, stride, groups)
             and npk.fits_vmem(
                 data.shape[1], data.shape[2], data.shape[3],

@@ -34,7 +34,6 @@ correct gradients), keeping the op trainable.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -45,7 +44,7 @@ try:
 except ImportError:  # pragma: no cover - jax builds without pallas-tpu
     pltpu = None
 
-_VMEM_BYTES = int(os.environ.get("RAFT_NCUP_VMEM_BYTES", str(16 * 1024 * 1024)))
+from raft_ncup_tpu.utils.runtime import VMEM_BYTES as _VMEM_BYTES
 
 
 def fits_vmem(h: int, w: int, cin: int, cout: int, k: int) -> bool:

@@ -1,0 +1,69 @@
+"""Runtime/platform facts shared by the Pallas kernels, the driver entry
+points, and the bench: which backends are TPU-class (Mosaic-lowerable),
+the per-core VMEM capacity, and the persistent-compilation-cache policy.
+One definition each — the kernels' dispatch thresholds and the two
+entry-point parents must never drift apart.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# Platform strings that are definitely NOT TPU-class. A denylist, not
+# `backend == "tpu"`: TPU-class plugins report their own platform strings
+# (the axon tunnel does) and must get the real Mosaic compile.
+NON_TPU_BACKENDS = ("cpu", "gpu", "cuda", "rocm")
+
+# Per-core VMEM capacity (~16 MiB on current TPUs —
+# /opt/skills/guides/pallas_guide.md "Memory Hierarchy").
+VMEM_BYTES = int(os.environ.get("RAFT_NCUP_VMEM_BYTES", str(16 * 1024 * 1024)))
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_CACHE_DIR = os.path.join(_REPO_ROOT, ".cache")
+
+
+def is_tpu_class_backend() -> bool:
+    """Whether the current default backend can lower Mosaic kernels."""
+    import jax
+
+    return jax.default_backend() not in NON_TPU_BACKENDS
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> None:
+    """Persistent XLA compilation cache — the dryrun and bench children
+    are compile-bound (minutes of XLA CPU compile for the 8-device SPMD
+    train step), so a warm cache turns repeat runs on one machine into
+    seconds and removes the watchdog-timeout risk entirely."""
+    import jax
+
+    path = os.path.join(cache_dir or DEFAULT_CACHE_DIR, "xla")
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # pragma: no cover - older jax knob names
+        print(f"compilation cache unavailable: {e}", file=sys.stderr)
+
+
+def wipe_compilation_cache_for_retry(
+    remaining_s: float, cache_dir: str | None = None
+) -> bool:
+    """Crash-retry policy shared by the dryrun and bench parents: a fast
+    child crash may be a poisoned cache (machine-feature-specific AOT
+    results can SIGILL), but wiping is only worth it when a retry will
+    actually run — otherwise a warm cache is destroyed for nothing and
+    every later run pays the multi-minute cold compile again. Returns
+    True iff the cache existed, the budget allows a retry, and the cache
+    was wiped."""
+    if remaining_s <= 120:
+        return False
+    import shutil
+
+    path = os.path.join(cache_dir or DEFAULT_CACHE_DIR, "xla")
+    if not os.path.isdir(path):
+        return False
+    shutil.rmtree(path, ignore_errors=True)
+    return True
