@@ -101,14 +101,13 @@ def _child_main() -> None:
 
     import jax
 
-    # The axon boot hook bakes JAX_PLATFORMS=axon into jax.config at
-    # interpreter start, which overrides the env var — the fallbacks must
-    # force the config itself (the tests/conftest.py recipe).
+    from raft_ncup_tpu.utils.runtime import (
+        enable_compilation_cache,
+        force_platform,
+    )
+
     if "_BENCH_FORCE_PLATFORM" in os.environ:
-        jax.config.update(
-            "jax_platforms", os.environ["_BENCH_FORCE_PLATFORM"]
-        )
-    from raft_ncup_tpu.utils.runtime import enable_compilation_cache
+        force_platform(os.environ["_BENCH_FORCE_PLATFORM"])
 
     enable_compilation_cache()
 
@@ -345,7 +344,7 @@ def main() -> None:
                     {"BENCH_CORR_IMPL": impl}, FULL, min(300.0, spare)
                 )
                 if r2:
-                    _maybe_record_baseline(dict(r2))
+                    _maybe_record_baseline(r2)
                     result[f"pairs_per_sec_{impl}"] = r2["value"]
                     if r2.get("train_pairs_per_sec") is not None:
                         result[f"train_pairs_per_sec_{impl}"] = r2[
@@ -400,7 +399,7 @@ def _maybe_record_baseline(result: dict) -> None:
     """First successful recording for a (platform, impl, shape) key becomes
     the fixed baseline later rounds are measured against. The driver
     commits repo changes at round end, so the file persists."""
-    key = result.pop("baseline_key", None)
+    key = result.get("baseline_key")
     if not key or not result.get("value"):
         return
     baselines = _load_baselines()

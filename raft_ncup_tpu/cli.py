@@ -118,10 +118,9 @@ def add_platform_arg(parser: argparse.ArgumentParser) -> None:
 
 def apply_platform(args: argparse.Namespace) -> None:
     if getattr(args, "platform", None):
-        os.environ["JAX_PLATFORMS"] = args.platform
-        import jax
+        from raft_ncup_tpu.utils.runtime import force_platform
 
-        jax.config.update("jax_platforms", args.platform)
+        force_platform(args.platform)
 
 
 def add_data_args(parser: argparse.ArgumentParser) -> None:

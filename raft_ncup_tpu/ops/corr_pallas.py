@@ -234,8 +234,22 @@ def _forward(
     K2 = (2 * radius + 1) ** 2
     outs: dict[int, jax.Array] = {}
     fallback = []
+    if pltpu is None:
+        # jax builds without pallas-tpu: the kernel can't declare its VMEM
+        # scratch there even in interpret mode, so every level routes to
+        # the equivalent XLA path. Warn so benchmark rows labeled 'pallas'
+        # aren't silently measuring the fallback.
+        import warnings
+
+        warnings.warn(
+            "pallas-tpu unavailable; corr_impl='pallas' is running the "
+            "XLA onthefly fallback",
+            stacklevel=2,
+        )
     for lvl, f2l in enumerate(f2_levels):
-        if fits_vmem(f2l.shape[1], f2l.shape[2], C, radius):
+        if pltpu is not None and fits_vmem(
+            f2l.shape[1], f2l.shape[2], C, radius
+        ):
             outs[lvl] = _lookup_one_level(
                 f1, f2l, cflat, radius, lvl, interpret=interpret
             )

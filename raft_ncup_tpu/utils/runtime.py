@@ -25,6 +25,17 @@ _REPO_ROOT = os.path.dirname(
 DEFAULT_CACHE_DIR = os.path.join(_REPO_ROOT, ".cache")
 
 
+def force_platform(platform: str) -> None:
+    """Force this process onto ``platform`` before any backend init. Both
+    writes are required: the axon boot hook bakes JAX_PLATFORMS=axon into
+    jax.config at interpreter start, so the env var alone cannot override
+    it, and child processes inherit only the env var."""
+    import jax
+
+    os.environ["JAX_PLATFORMS"] = platform
+    jax.config.update("jax_platforms", platform)
+
+
 def is_tpu_class_backend() -> bool:
     """Whether the current default backend can lower Mosaic kernels."""
     import jax
