@@ -118,6 +118,7 @@ def _child_main() -> None:
 
     shape = json.loads(os.environ.get("_BENCH_SHAPE") or json.dumps(FULL))
     corr_impl = os.environ.get("BENCH_CORR_IMPL", "volume")
+    nconv_impl = os.environ.get("RAFT_NCUP_NCONV_IMPL", "xla")
     platform = jax.devices()[0].platform
     if platform == "cpu" and shape == FULL:
         # Full-res NCUP x12 iters is a TPU workload; on a host-CPU backend
@@ -183,14 +184,17 @@ def _child_main() -> None:
         else None
     )
 
-    key = _baseline_key(platform, corr_impl, shape)
+    impl_label = corr_impl + (
+        f"+nconv_{nconv_impl}" if nconv_impl != "xla" else ""
+    )
+    key = _baseline_key(platform, impl_label, shape)
     baseline = _load_baselines().get(key)
     vs = pairs_per_sec / baseline if baseline else 1.0
     record = {
         "metric": (
             f"raft_nc_dbl frame-pairs/sec/chip @ {shape['iters']} "
             f"iters {shape['height']}x{shape['width']} "
-            f"({platform}, corr={corr_impl})"
+            f"({platform}, corr={corr_impl}, nconv={nconv_impl})"
         ),
         "value": round(pairs_per_sec, 4),
         "unit": "pairs/s",
@@ -333,21 +337,25 @@ def main() -> None:
         if budget > 60:
             result, _ = _run_child({}, FULL, budget)
         # Secondary rows, budget permitting: the alternative corr
-        # implementations at the same shape (VERDICT.md next-round #2/#3 —
-        # the data that decides the default kernel on hardware).
+        # implementations and the fused NConv kernel at the same shape
+        # (VERDICT.md next-round #2/#3/#5 — the data that decides the
+        # default kernels on hardware).
         if result:
-            for impl in ("onthefly", "pallas"):
+            variants = [
+                ("onthefly", {"BENCH_CORR_IMPL": "onthefly"}),
+                ("pallas", {"BENCH_CORR_IMPL": "pallas"}),
+                ("nconv_pallas", {"RAFT_NCUP_NCONV_IMPL": "pallas"}),
+            ]
+            for tag, env in variants:
                 spare = remaining() - CPU_RESERVE_S / 2
                 if spare < 150:
                     break
-                r2, _ = _run_child(
-                    {"BENCH_CORR_IMPL": impl}, FULL, min(300.0, spare)
-                )
+                r2, _ = _run_child(env, FULL, min(300.0, spare))
                 if r2:
                     _maybe_record_baseline(r2)
-                    result[f"pairs_per_sec_{impl}"] = r2["value"]
+                    result[f"pairs_per_sec_{tag}"] = r2["value"]
                     if r2.get("train_pairs_per_sec") is not None:
-                        result[f"train_pairs_per_sec_{impl}"] = r2[
+                        result[f"train_pairs_per_sec_{tag}"] = r2[
                             "train_pairs_per_sec"
                         ]
     elif probe == "cpu":
