@@ -63,9 +63,10 @@ def main(argv=None) -> None:
 
         mesh = make_mesh(data=1, spatial=args.spatial_parallel)
 
+    iters_kw = {"iters": args.iters} if args.iters is not None else {}
     if args.submission:
         if args.dataset == "sintel":
-            kwargs = {}
+            kwargs = dict(iters_kw)
             if args.output_path:
                 kwargs["output_path"] = args.output_path
             create_sintel_submission(
@@ -74,7 +75,7 @@ def main(argv=None) -> None:
                 mesh=mesh, **kwargs,
             )
         elif args.dataset == "kitti":
-            kwargs = {}
+            kwargs = dict(iters_kw)
             if args.output_path:
                 kwargs["output_path"] = args.output_path
             create_kitti_submission(
@@ -85,7 +86,9 @@ def main(argv=None) -> None:
             raise SystemExit("--submission supports sintel/kitti only")
         return
 
-    results = VALIDATORS[args.dataset](model, variables, data_cfg, mesh=mesh)
+    results = VALIDATORS[args.dataset](
+        model, variables, data_cfg, mesh=mesh, **iters_kw
+    )
     print(results)
 
 

@@ -290,6 +290,10 @@ def build_eval_parser() -> argparse.ArgumentParser:
                         help="shard eval height over this many devices "
                         "(high-res inference; pairs with --corr_impl "
                         "onthefly)")
+    parser.add_argument("--iters", type=int, default=None,
+                        help="GRU iteration override; default keeps each "
+                        "validator's reference setting (sintel 32, "
+                        "chairs/kitti 24 — reference evaluate.py)")
     add_model_args(parser)
     add_data_args(parser)
     add_platform_arg(parser)

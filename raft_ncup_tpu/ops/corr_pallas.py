@@ -68,6 +68,24 @@ from raft_ncup_tpu.utils.runtime import VMEM_BYTES as _VMEM_BYTES
 _QUERY_BLOCK = 512
 _GROUP = 8  # queries per vectorized inner step (sublane tile)
 
+# Trace-time per-level dispatch tally, mirroring ops.nconv: callers that
+# label a measurement "corr=pallas" (bench.py) use this to tell whether
+# the kernel took any level at all or everything fell back to XLA
+# onthefly (partial fallback — e.g. 1080p level 0 — is by design and
+# still counts as the kernel running).
+_dispatch_counts = {"kernel": 0, "fallback": 0, "levels_total": 0}
+
+
+def reset_dispatch_counts() -> None:
+    for k in _dispatch_counts:
+        _dispatch_counts[k] = 0
+
+
+def dispatch_counts() -> dict:
+    """Copy of the per-level dispatch tally since the last reset (counts
+    trace-time decisions, one per pyramid level per compile)."""
+    return dict(_dispatch_counts)
+
 
 def _padded_hw(h: int, w: int, radius: int) -> tuple[int, int, int]:
     # A fully-OOB window is clamped to the array edge and must land
@@ -234,6 +252,7 @@ def _forward(
     K2 = (2 * radius + 1) ** 2
     outs: dict[int, jax.Array] = {}
     fallback = []
+    _dispatch_counts["levels_total"] += num_levels
     if pltpu is None:
         # jax builds without pallas-tpu: the kernel can't declare its VMEM
         # scratch there even in interpret mode, so every level routes to
@@ -250,12 +269,26 @@ def _forward(
         if pltpu is not None and fits_vmem(
             f2l.shape[1], f2l.shape[2], C, radius
         ):
+            _dispatch_counts["kernel"] += 1
             outs[lvl] = _lookup_one_level(
                 f1, f2l, cflat, radius, lvl, interpret=interpret
             )
         else:
+            _dispatch_counts["fallback"] += 1
             fallback.append(lvl)
     if fallback:
+        if pltpu is not None and len(fallback) == num_levels:
+            # Same mislabeled-measurement hazard as the pltpu-is-None
+            # branch above: every level rejected by fits_vmem means
+            # corr_impl='pallas' is measuring pure XLA onthefly.
+            import warnings
+
+            warnings.warn(
+                f"all {num_levels} corr pyramid levels exceed the VMEM "
+                "budget; corr_impl='pallas' is running the XLA onthefly "
+                "fallback for every level",
+                stacklevel=2,
+            )
         fb = corr_lookup_onthefly(
             fmap1, fmap2, coords, radius, num_levels, levels=tuple(fallback)
         ).reshape(B, H * W, len(fallback) * K2)

@@ -22,6 +22,25 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+# Trace-time dispatch tally for the fused-kernel path: callers that label a
+# measurement "nconv=pallas" (bench.py) must be able to tell whether the
+# fused kernel actually ran or every call silently fell back to XLA
+# (ADVICE r3: a baseline pinned under '+nconv_pallas' that measured the
+# XLA path would poison every later comparison).
+_dispatch_counts = {"fused": 0, "fallback": 0}
+
+
+def reset_dispatch_counts() -> None:
+    _dispatch_counts["fused"] = 0
+    _dispatch_counts["fallback"] = 0
+
+
+def dispatch_counts() -> dict:
+    """Copy of the {'fused', 'fallback'} tally since the last reset.
+    Counts trace-time decisions (one per distinct nconv2d call site per
+    compile), not runtime executions."""
+    return dict(_dispatch_counts)
+
 
 def positivity(raw: jax.Array, pos_fn: str = "softplus") -> jax.Array:
     """Map a raw parameter to a non-negative kernel.
@@ -91,8 +110,20 @@ def nconv2d(
             )
         )
         if fused_ok:
+            _dispatch_counts["fused"] += 1
             out, conf_out = npk.nconv2d_fused(data, conf, weight, bias, eps)
             return out, (conf_out if propagate_conf else None)
+        _dispatch_counts["fallback"] += 1
+        import warnings
+
+        warnings.warn(
+            "nconv impl='pallas' fell back to XLA for shape "
+            f"data={tuple(data.shape)} weight={tuple(weight.shape)} "
+            f"stride={stride} groups={groups} (backend tpu-class: "
+            f"{is_tpu_class_backend()}) — measurements labeled "
+            "nconv=pallas did NOT run the fused kernel here",
+            stacklevel=2,
+        )
     kh, kw = weight.shape[0], weight.shape[1]
     pad = ((kh // 2, kh // 2), (kw // 2, kw // 2))
     dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, ("NHWC", "HWIO", "NHWC"))

@@ -55,9 +55,25 @@ def fits_vmem(h: int, w: int, cin: int, cout: int, k: int) -> bool:
     return 4 * slabs <= int(0.75 * _VMEM_BYTES)
 
 
+# The kernel body unrolls cout * k * k * cin Python loop iterations
+# (one vector FMA each). NCUP's nconvs are 1-2 channels (5x5x2x2 = 100
+# iterations); past a few hundred the unrolled Mosaic program blows up
+# compile time and VMEM register pressure, so cap it and let XLA take
+# those shapes.
+MAX_UNROLL = 256
+
+
 def supported(weight_shape, stride: int, groups: int) -> bool:
-    kh, kw = weight_shape[0], weight_shape[1]
-    return kh == kw and kh % 2 == 1 and stride == 1 and groups == 1
+    kh, kw, cin, cout = (
+        weight_shape[0], weight_shape[1], weight_shape[2], weight_shape[3],
+    )
+    return (
+        kh == kw
+        and kh % 2 == 1
+        and stride == 1
+        and groups == 1
+        and kh * kw * cin * cout <= MAX_UNROLL
+    )
 
 
 def _kernel(dc_ref, c_ref, w_ref, wsum_ref, bias_ref, out_ref, cout_ref, *,

@@ -25,6 +25,27 @@ _REPO_ROOT = os.path.dirname(
 DEFAULT_CACHE_DIR = os.path.join(_REPO_ROOT, ".cache")
 
 
+def host_fingerprint() -> str:
+    """Stable-ish host id (cpu model + core count, sha1/8). Used to key
+    CPU perf baselines (cross-host CPU numbers differ >2x — r2 data) and
+    to segregate the persistent XLA cache per machine: XLA:CPU AOT
+    entries bake machine features (+prefer-no-scatter etc.) that other
+    hosts load with 'could lead to SIGILL' errors — observed r4 when a
+    different session's cache entries landed in this repo's .cache/."""
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            model = next(
+                (l.split(":", 1)[1].strip() for l in f if "model name" in l),
+                "unknown",
+            )
+    except OSError:
+        model = "unknown"
+    raw = f"{model}|{os.cpu_count()}"
+    return hashlib.sha1(raw.encode()).hexdigest()[:8]
+
+
 def force_platform(platform: str) -> None:
     """Force this process onto ``platform`` before any backend init. Both
     writes are required: the axon boot hook bakes JAX_PLATFORMS=axon into
@@ -50,7 +71,13 @@ def enable_compilation_cache(cache_dir: str | None = None) -> None:
     seconds and removes the watchdog-timeout risk entirely."""
     import jax
 
-    path = os.path.join(cache_dir or DEFAULT_CACHE_DIR, "xla")
+    # Per-host subdir: XLA:CPU AOT entries are machine-feature-specific
+    # (see host_fingerprint) and /root/repo/.cache is shared between the
+    # builder's, the judge's, and the driver's sessions — which may run
+    # on different machines.
+    path = os.path.join(
+        cache_dir or DEFAULT_CACHE_DIR, f"xla-{host_fingerprint()}"
+    )
     try:
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
@@ -73,7 +100,9 @@ def wipe_compilation_cache_for_retry(
         return False
     import shutil
 
-    path = os.path.join(cache_dir or DEFAULT_CACHE_DIR, "xla")
+    path = os.path.join(
+        cache_dir or DEFAULT_CACHE_DIR, f"xla-{host_fingerprint()}"
+    )
     if not os.path.isdir(path):
         return False
     shutil.rmtree(path, ignore_errors=True)

@@ -1,0 +1,88 @@
+"""Child program for the REAL 2-process jax.distributed test.
+
+Each of two processes runs this file with 2 virtual CPU devices, joins
+the distributed runtime through ``initialize_distributed`` (the
+non-trivial branch of parallel/multihost.py), assembles its host-local
+half of a global batch, and executes ONE sharded train step over the
+4-device global mesh. Prints ``LOSS=<value>`` on success; the parent
+test asserts both processes exit 0 and agree on the loss.
+
+Not a pytest file — invoked by tests/test_multihost.py.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    port, pid = sys.argv[1], int(sys.argv[2])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from raft_ncup_tpu.config import TrainConfig, small_model_config
+    from raft_ncup_tpu.parallel import (
+        batch_sharding,
+        global_batch,
+        initialize_distributed,
+        is_multihost,
+        make_mesh,
+        make_train_step,
+    )
+    from raft_ncup_tpu.parallel.mesh import replicated
+    from raft_ncup_tpu.training.state import create_train_state
+
+    initialize_distributed(f"127.0.0.1:{port}", 2, pid)
+    assert jax.process_count() == 2, jax.process_count()
+    assert is_multihost()
+    assert len(jax.devices()) == 4  # 2 hosts x 2 local CPU devices
+
+    mesh = make_mesh(data=4, spatial=1)
+    mcfg = small_model_config("raft", dataset="chairs")
+    tcfg = TrainConfig(
+        stage="chairs", batch_size=4, image_size=(16, 32), iters=1,
+        num_steps=5,
+    )
+    # Same seed on every process -> identical replicated init (SPMD).
+    model, state = create_train_state(
+        jax.random.PRNGKey(0), mcfg, tcfg, (1, 16, 32, 3)
+    )
+    repl = replicated(mesh)
+    state = jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(
+            repl, np.asarray(x)
+        ),
+        state,
+    )
+
+    # Each host contributes its disjoint half of the global batch of 4
+    # (rows [2*pid, 2*pid+2)) — the FlowLoader host-sharding contract.
+    g = np.random.default_rng(42)
+    full = {
+        "image1": g.uniform(0, 255, (4, 16, 32, 3)).astype(np.float32),
+        "image2": g.uniform(0, 255, (4, 16, 32, 3)).astype(np.float32),
+        "flow": g.normal(size=(4, 16, 32, 2)).astype(np.float32),
+        "valid": np.ones((4, 16, 32), np.float32),
+    }
+    local = {k: v[2 * pid : 2 * pid + 2] for k, v in full.items()}
+    batch = global_batch(local, mesh, batch_sharding(mesh))
+
+    step = make_train_step(model, tcfg, mesh=mesh)
+    state, metrics = step(state, batch, jax.random.PRNGKey(7))
+    loss = float(metrics["loss"])
+    assert np.isfinite(loss)
+    print(f"LOSS={loss:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

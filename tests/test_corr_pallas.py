@@ -78,6 +78,97 @@ class TestPallasLookup:
                 np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3
             )
 
+    def test_query_count_not_multiple_of_group(self):
+        """Adversarial (VERDICT r3 #3): H*W = 35 queries, not a multiple
+        of the kernel's group-of-8 tiling — the tail group must still
+        match the volume path exactly."""
+        h, w = 5, 7
+        g = np.random.default_rng(3)
+        fmap1 = jnp.asarray(g.normal(size=(1, h, w, C)), jnp.float32)
+        fmap2 = jnp.asarray(g.normal(size=(1, h, w, C)), jnp.float32)
+        coords = coords_grid(1, h, w) + jnp.asarray(
+            g.uniform(-2.0, 2.0, (1, h, w, 2)), jnp.float32
+        )
+        ref = corr_lookup(
+            build_corr_pyramid(fmap1, fmap2, 2), coords, RADIUS
+        )
+        out = corr_lookup_pallas(fmap1, fmap2, coords, RADIUS, 2, True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4
+        )
+
+    def test_every_window_fully_out_of_bounds(self):
+        """Adversarial: displacements larger than the image in all four
+        directions — every tap of every window is OOB, output must be
+        exactly the reference's (zeros), no clamping artifacts."""
+        fmap1, fmap2 = setup()
+        big = 4.0 * max(H, W)
+        for dx, dy in ((big, 0.0), (-big, 0.0), (0.0, big), (-big, -big)):
+            coords = coords_grid(B, H, W) + jnp.asarray(
+                [dx, dy], jnp.float32
+            )
+            ref = corr_lookup(
+                build_corr_pyramid(fmap1, fmap2, LEVELS), coords, RADIUS
+            )
+            out = corr_lookup_pallas(
+                fmap1, fmap2, coords, RADIUS, LEVELS, True
+            )
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4
+            )
+
+    def test_mixed_level_dispatch_matches(self, monkeypatch):
+        """Adversarial: a VMEM budget that rejects level 0 but accepts
+        deeper levels (the 1080p dispatch boundary) — the stitched
+        kernel+fallback output must equal the pure XLA path."""
+        from raft_ncup_tpu.ops import corr_pallas as cpk
+
+        if cpk.pltpu is None:
+            pytest.skip("pallas-tpu unavailable; dispatch loop can't "
+                        "take the kernel branch")
+        fmap1, fmap2 = setup()
+        coords = coords_grid(B, H, W) + 0.25
+        ref = corr_lookup(
+            build_corr_pyramid(fmap1, fmap2, LEVELS), coords, RADIUS
+        )
+        level0_bytes = cpk._level_vmem_bytes(H, W, C, RADIUS)
+        dispatched = []
+        real_fits = cpk.fits_vmem
+
+        def fits(h, w, c, radius=4):
+            ok = cpk._level_vmem_bytes(h, w, c, radius) < level0_bytes
+            dispatched.append(((h, w), ok))
+            return ok
+
+        monkeypatch.setattr(cpk, "fits_vmem", fits)
+        cpk.reset_dispatch_counts()
+        out = corr_lookup_pallas(fmap1, fmap2, coords, RADIUS, LEVELS, True)
+        monkeypatch.setattr(cpk, "fits_vmem", real_fits)
+        # Level 0 fell back, at least one deeper level took the kernel —
+        # and the module tally (bench.py's honesty signal) agrees.
+        assert dispatched[0][1] is False
+        assert any(ok for _, ok in dispatched[1:])
+        counts = cpk.dispatch_counts()
+        assert counts["levels_total"] == LEVELS
+        assert counts["fallback"] >= 1 and counts["kernel"] >= 1
+        assert counts["kernel"] + counts["fallback"] == LEVELS
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4
+        )
+
+    def test_all_levels_fallback_warns(self, monkeypatch):
+        """ADVICE r3: when fits_vmem rejects every level, the 'pallas'
+        label silently measures XLA — a warning must say so."""
+        from raft_ncup_tpu.ops import corr_pallas as cpk
+
+        if cpk.pltpu is None:
+            pytest.skip("pallas-tpu unavailable; pltpu-None branch warns")
+        fmap1, fmap2 = setup()
+        coords = coords_grid(B, H, W)
+        monkeypatch.setattr(cpk, "fits_vmem", lambda *a, **k: False)
+        with pytest.warns(UserWarning, match="onthefly fallback for every"):
+            cpk.corr_lookup_pallas(fmap1, fmap2, coords, RADIUS, LEVELS, True)
+
     def test_model_runs_with_pallas_impl(self):
         # On a non-TPU backend the model selects interpret mode itself
         # (models/raft.py), so corr_impl='pallas' works unpatched.

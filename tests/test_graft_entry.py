@@ -1,0 +1,111 @@
+"""Driver entry-point contract tests (__graft_entry__).
+
+The driver compile-checks ``entry()`` single-chip and executes
+``dryrun_multichip(8)`` on a virtual CPU mesh; three rounds of rc=124
+artifacts traced to the caller's process initializing the wedged axon
+backend (round-3 postmortem). These tests pin the guards that prevent
+that, plus the dryrun itself exactly as the driver invokes it.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import __graft_entry__ as ge
+from raft_ncup_tpu.utils import backend_probe
+
+
+@pytest.fixture(autouse=True)
+def _clear_guard_cache():
+    ge._BACKEND_GUARD_CACHE.clear()
+    yield
+    ge._BACKEND_GUARD_CACHE.clear()
+
+
+def test_guard_trusts_inherited_cpu_env(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+
+    def boom(*a, **k):  # the probe must not run when env is already cpu
+        raise AssertionError("probe_backend called")
+
+    monkeypatch.setattr(backend_probe, "probe_backend", boom)
+    assert ge.ensure_live_backend_for_caller() == "inherited-cpu"
+
+
+def test_guard_passes_live_accelerator_through(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setattr(
+        backend_probe,
+        "probe_backend",
+        lambda *a, **k: backend_probe.ProbeResult("axon", "ok"),
+    )
+    assert ge.ensure_live_backend_for_caller() == "live"
+    # A live backend must be left untouched.
+    assert os.environ["JAX_PLATFORMS"] == "axon"
+
+
+def test_guard_forces_cpu_when_backend_hangs(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setattr(
+        backend_probe,
+        "probe_backend",
+        lambda *a, **k: backend_probe.ProbeResult(
+            None, "hung", "probe exceeded 90s"
+        ),
+    )
+    assert ge.ensure_live_backend_for_caller() == "forced-cpu"
+    # force_platform must have repointed BOTH the env var and jax.config
+    # (the config side is what the caller's jit actually reads).
+    assert os.environ["JAX_PLATFORMS"] == "cpu"
+    import jax
+
+    assert jax.config.jax_platforms == "cpu"
+
+
+def test_guard_probes_at_most_once(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    calls = []
+
+    def probe(*a, **k):
+        calls.append(1)
+        return backend_probe.ProbeResult("axon", "ok")
+
+    monkeypatch.setattr(backend_probe, "probe_backend", probe)
+    assert ge.ensure_live_backend_for_caller() == "live"
+    assert ge.ensure_live_backend_for_caller() == "live"
+    assert len(calls) == 1
+
+
+def test_cpu_mesh_ready_reads_env_only(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+    assert ge._cpu_mesh_ready(8)
+    assert not ge._cpu_mesh_ready(16)
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    assert not ge._cpu_mesh_ready(8)
+
+
+@pytest.mark.slow
+def test_entry_returns_jittable_fn_with_numpy_args():
+    """The driver's single-chip compile check: entry() then jit-trace."""
+    fn, args = ge.entry()
+    variables, img1, img2 = args
+    assert isinstance(img1, np.ndarray) and isinstance(img2, np.ndarray)
+    import jax
+
+    # .lower() traces the full flagship forward (what the driver's
+    # compile check does before .compile()).
+    lowered = jax.jit(fn).lower(variables, img1, img2)
+    assert lowered is not None
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_in_process_8_devices(capsys):
+    """The driver artifact, exactly as invoked: conftest's env matches
+    _cpu_mesh_ready so this exercises the in-process path."""
+    ge.dryrun_multichip(8)
+    out = capsys.readouterr().out
+    assert "dryrun_multichip ok" in out

@@ -1,8 +1,11 @@
 """Multi-host helpers under the single-process 8-device CPU mesh."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from raft_ncup_tpu.parallel import (
     batch_sharding,
@@ -67,3 +70,51 @@ class TestMultihost:
         )
         state, metrics = step(state, batch, jax.random.PRNGKey(1))
         assert np.isfinite(float(metrics["loss"]))
+
+
+@pytest.mark.slow
+def test_two_process_distributed_train_step():
+    """VERDICT r3 #6: exercise initialize_distributed's NON-trivial branch
+    with a real 2-process jax.distributed runtime — each process owns 2
+    virtual CPU devices, one sharded train step runs over the 4-device
+    global mesh, and both processes must agree on the loss (SPMD)."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    child = os.path.join(os.path.dirname(__file__), "_distributed_child.py")
+    env = dict(os.environ)
+    # The children build their own 2-device CPU platform; drop the
+    # conftest's 8-device flag so it doesn't override theirs.
+    env["XLA_FLAGS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, child, str(port), str(pid)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=540)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    losses = []
+    for rc, out, err in outs:
+        assert rc == 0, f"child failed rc={rc}\n{out}\n{err[-2000:]}"
+        line = next(l for l in out.splitlines() if l.startswith("LOSS="))
+        losses.append(float(line.split("=")[1]))
+    assert losses[0] == pytest.approx(losses[1], rel=1e-6)
