@@ -252,6 +252,31 @@ class TestEvalDriverMesh:
         np.testing.assert_allclose(s2["final"], s1["final"], rtol=1e-4)
 
 
+class TestDemoDriver:
+    def test_demo_writes_flow_visualizations(self, tmp_path, capsys):
+        """demo.py end-to-end: folder of frames in, side-by-side flow
+        pngs out (reference: demo.py:50-68; C18)."""
+        import demo as demo_driver
+
+        frames = tmp_path / "frames"
+        frames.mkdir()
+        g = np.random.default_rng(9)
+        for i in range(3):
+            Image.fromarray(
+                g.integers(0, 255, (48, 64, 3), dtype=np.uint8)
+            ).save(frames / f"frame_{i:02d}.png")
+        out = tmp_path / "out"
+        demo_driver.main([
+            "--path", str(frames), "--output", str(out),
+            "--model", "raft", "--small", "--iters", "2",
+        ])
+        written = sorted(os.listdir(out))
+        assert written == ["frame_00_flow.png", "frame_01_flow.png"]
+        vis = np.asarray(Image.open(out / written[0]))
+        # Side-by-side stack: frame on top, colorized flow below.
+        assert vis.shape == (96, 64, 3)
+
+
 class TestTrainDriver:
     def test_train_resume_cycle(self, tmp_path, monkeypatch):
         """End-to-end composition through ``main(argv)``: loader, val
