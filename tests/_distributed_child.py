@@ -18,16 +18,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> None:
     port, pid = sys.argv[1], int(sys.argv[2])
-    os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=2"
     ).strip()
 
+    from raft_ncup_tpu.utils.runtime import (
+        enable_compilation_cache,
+        force_platform,
+    )
+
+    force_platform("cpu")
+    enable_compilation_cache()  # repeat suite runs hit warm executables
+
     import jax
-
-    jax.config.update("jax_platforms", "cpu")
-
     import numpy as np
 
     from raft_ncup_tpu.config import TrainConfig, small_model_config

@@ -1,0 +1,73 @@
+"""Tests for the shared runtime/platform facts module
+(raft_ncup_tpu.utils.runtime) — the single source of truth for platform
+forcing, the per-host XLA cache policy, and the cache wipe-retry rule.
+"""
+
+import os
+
+import pytest
+
+from raft_ncup_tpu.utils import runtime
+
+
+def test_host_fingerprint_stable_and_short():
+    fp = runtime.host_fingerprint()
+    assert fp == runtime.host_fingerprint()
+    assert len(fp) == 8
+    int(fp, 16)  # hex
+
+
+def test_cache_dir_is_host_fingerprinted(tmp_path):
+    """Cache entries must never be shared across machines: XLA:CPU AOT
+    results bake machine features other hosts load at SIGILL risk."""
+    import jax
+
+    restore = {
+        k: getattr(jax.config, k)
+        for k in (
+            "jax_compilation_cache_dir",
+            "jax_persistent_cache_min_compile_time_secs",
+            "jax_persistent_cache_min_entry_size_bytes",
+        )
+    }
+    try:
+        runtime.enable_compilation_cache(str(tmp_path))
+        configured = jax.config.jax_compilation_cache_dir
+        assert configured == str(
+            tmp_path / f"xla-{runtime.host_fingerprint()}"
+        )
+    finally:
+        for k, v in restore.items():
+            jax.config.update(k, v)
+
+
+def test_wipe_policy_budget_and_paths(tmp_path):
+    target = tmp_path / f"xla-{runtime.host_fingerprint()}"
+    target.mkdir()
+    (target / "entry").write_bytes(b"x")
+    # Too little budget left: a retry couldn't run, keep the warm cache.
+    assert not runtime.wipe_compilation_cache_for_retry(60, str(tmp_path))
+    assert target.exists()
+    # Enough budget: wipe THIS host's subdir only.
+    other = tmp_path / "xla-deadbeef"
+    other.mkdir()
+    assert runtime.wipe_compilation_cache_for_retry(600, str(tmp_path))
+    assert not target.exists()
+    assert other.exists()
+    # Nothing to wipe -> False.
+    assert not runtime.wipe_compilation_cache_for_retry(600, str(tmp_path))
+
+
+def test_force_platform_writes_env_and_config(monkeypatch):
+    import jax
+
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    runtime.force_platform("cpu")
+    assert os.environ["JAX_PLATFORMS"] == "cpu"
+    assert jax.config.jax_platforms == "cpu"
+
+
+def test_tpu_class_denylist():
+    # The conftest forces the cpu backend for the whole suite.
+    assert not runtime.is_tpu_class_backend()
+    assert "cpu" in runtime.NON_TPU_BACKENDS
