@@ -36,10 +36,11 @@ VMEM budget: the padded level must stay resident on-chip next to the
 pipeline's block buffers. The budget is derived from the per-core VMEM
 capacity (~16 MiB on current TPUs — /opt/skills/guides/pallas_guide.md
 "Memory Hierarchy"; override with RAFT_NCUP_VMEM_BYTES) minus the blocked
-operands' double buffers. Dispatch is PER LEVEL: at 1080p level 0
-(~42 MB padded) falls back to the XLA on-the-fly path while levels 1-3
-still take the kernel (round-2 gated all-or-nothing on level 0 —
-VERDICT.md weak #4).
+operands' double buffers. Dispatch is PER LEVEL: at 1080p levels 0-1
+(~42 MB and ~15.3 MB padded, both over the 0.9x budget) fall back to
+the XLA on-the-fly path while levels 2-3 still take the kernel
+(round-2 gated all-or-nothing on level 0 — VERDICT.md weak #4; exact
+counts pinned by tests/test_pallas_lowering.py).
 
 The kernel is forward-only; ``corr_lookup_pallas`` wraps it in a
 ``jax.custom_vjp`` whose backward runs the XLA on-the-fly path's VJP, so
