@@ -265,7 +265,7 @@ class RAFT:
 
             # Dispatch is per pyramid level inside the op: levels whose
             # padded slab fits the VMEM budget take the kernel, the rest
-            # (1080p level 0) take the XLA on-the-fly path. Shapes are
+            # (at 1080p: levels 0-1) take the XLA on-the-fly path. Shapes are
             # static at trace time, so this is a compile-time choice.
             # Mosaic lowers only on TPU-class backends; on non-TPU
             # platforms the kernel runs in interpret mode (slow but

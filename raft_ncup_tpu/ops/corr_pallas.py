@@ -72,7 +72,7 @@ _GROUP = 8  # queries per vectorized inner step (sublane tile)
 # Trace-time per-level dispatch tally, mirroring ops.nconv: callers that
 # label a measurement "corr=pallas" (bench.py) use this to tell whether
 # the kernel took any level at all or everything fell back to XLA
-# onthefly (partial fallback — e.g. 1080p level 0 — is by design and
+# onthefly (partial fallback — e.g. 1080p levels 0-1 — is by design and
 # still counts as the kernel running).
 _dispatch_counts = {"kernel": 0, "fallback": 0, "levels_total": 0}
 
@@ -241,7 +241,7 @@ def _forward(
 ) -> jax.Array:
     """Volume-free fused lookup over all pyramid levels, with PER-LEVEL
     dispatch: levels whose padded slab fits VMEM take the kernel, the rest
-    take the equivalent XLA on-the-fly path (1080p level 0)."""
+    take the equivalent XLA on-the-fly path (1080p levels 0-1)."""
     from raft_ncup_tpu.ops.corr import _pool_fmap_pyramid, corr_lookup_onthefly
 
     B, H, W, C = fmap1.shape

@@ -4,6 +4,7 @@ from raft_ncup_tpu.parallel.mesh import (  # noqa: F401
     replicated,
 )
 from raft_ncup_tpu.parallel.multihost import (  # noqa: F401
+    barrier,
     global_batch,
     initialize_distributed,
     is_multihost,

@@ -74,6 +74,32 @@ def is_multihost() -> bool:
     return jax.process_count() > 1
 
 
+def barrier(name: str, timeout_s: float = 480.0) -> bool:
+    """Block until every process reaches this barrier (coordination
+    service — no device collectives involved, so it tolerates arbitrary
+    cross-process skew, unlike Gloo/ICI ops whose context init has a
+    hard ~30s deadline). Use it to align processes before the first
+    collective execution when their compile times can drift apart.
+
+    Returns False (after logging) instead of raising when this jax
+    build's distributed client doesn't expose the barrier API — the
+    jax._src access is isolated HERE so a jax upgrade breaks one
+    maintained helper, not every caller.
+    """
+    if not is_multihost():
+        return True
+    try:
+        from jax._src import distributed
+
+        distributed.global_state.client.wait_at_barrier(
+            name, timeout_in_ms=int(timeout_s * 1000)
+        )
+        return True
+    except (ImportError, AttributeError) as e:
+        print(f"multihost barrier unavailable ({e}); proceeding unaligned")
+        return False
+
+
 def replicated_hosts_sharding(mesh: Mesh) -> NamedSharding:
     from jax.sharding import PartitionSpec as P
 

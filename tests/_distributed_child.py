@@ -81,8 +81,21 @@ def main() -> None:
     local = {k: v[2 * pid : 2 * pid + 2] for k, v in full.items()}
     batch = global_batch(local, mesh, batch_sharding(mesh))
 
+    # AOT-compile (pure local work, arbitrary cross-process skew allowed
+    # — on a loaded 1-core host the two children's compiles can drift
+    # apart by minutes), then BARRIER before executing. The execution is
+    # where every cross-process wait with a short hard deadline lives
+    # (Gloo context init: 30s; collective op waits), so both processes
+    # must enter it near-simultaneously — an unaligned entry was the
+    # observed CI flake.
+    from raft_ncup_tpu.parallel import barrier
+
     step = make_train_step(model, tcfg, mesh=mesh)
-    state, metrics = step(state, batch, jax.random.PRNGKey(7))
+    rng = jax.random.PRNGKey(7)
+    compiled = step.lower(state, batch, rng).compile()
+    barrier("step-compiled")
+
+    state, metrics = compiled(state, batch, rng)
     loss = float(metrics["loss"])
     assert np.isfinite(loss)
     print(f"LOSS={loss:.6f}", flush=True)

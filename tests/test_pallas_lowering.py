@@ -28,6 +28,18 @@ pytestmark = pytest.mark.skipif(
 )
 
 
+@pytest.fixture(autouse=True)
+def _pin_vmem_budget(monkeypatch):
+    """The dispatch-count pins below are budget-sensitive (1080p level 1
+    misses the default 16 MiB budget by ~1.3%), so test the gating logic
+    against the default budget, not the ambient RAFT_NCUP_VMEM_BYTES
+    override."""
+    from raft_ncup_tpu.ops import nconv_pallas as npk
+
+    monkeypatch.setattr(cpk, "_VMEM_BYTES", 16 * 1024 * 1024)
+    monkeypatch.setattr(npk, "_VMEM_BYTES", 16 * 1024 * 1024)
+
+
 def _lower_for_tpu(fn, *args):
     return jax.jit(fn).trace(*args).lower(
         lowering_platforms=("tpu",)
