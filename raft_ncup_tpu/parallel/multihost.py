@@ -95,7 +95,10 @@ def barrier(name: str, timeout_s: float = 480.0) -> bool:
             name, timeout_in_ms=int(timeout_s * 1000)
         )
         return True
-    except (ImportError, AttributeError) as e:
+    except (ImportError, AttributeError, TypeError) as e:
+        # TypeError included: the unstable jax._src signature changing
+        # (e.g. the timeout keyword renamed) must degrade like the API
+        # being absent, per this helper's contract.
         print(f"multihost barrier unavailable ({e}); proceeding unaligned")
         return False
 

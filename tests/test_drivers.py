@@ -328,6 +328,35 @@ class TestTrainDriver:
         log = (run_dir / "log.txt").read_text()
         assert "restored step 3" in log
 
+    def test_train_cli_mesh_flags(self, tmp_path, monkeypatch):
+        """The driver-flag multichip path: train.py --data_parallel 2
+        --spatial_parallel 2 builds a (2 x 2) mesh over the virtual
+        devices and trains on it (reference's 2-GPU DataParallel
+        analogue, train.py:169-175)."""
+        import train as train_driver
+
+        monkeypatch.chdir(tmp_path)
+        train_driver.main([
+            "--name", "mesh_smoke",
+            "--model", "raft",
+            "--small",
+            "--stage", "chairs",
+            "--image_size", "32", "48",
+            "--batch_size", "2",
+            "--iters", "2",
+            "--num_steps", "2",
+            "--sum_freq", "1",
+            "--synthetic_ok",
+            "--num_workers", "1",
+            "--data_parallel", "2",
+            "--spatial_parallel", "2",
+            "--root_chairs", str(tmp_path / "missing"),
+        ])
+        run_dir = tmp_path / "checkpoints" / "mesh_smoke"
+        log = (run_dir / "log.txt").read_text()
+        assert "mesh=(2 data x 2 spatial)" in log
+        assert (run_dir / "2").exists()
+
 
 def test_validate_synthetic_heldout():
     """The synthetic validator runs on a held-out procedural split and
