@@ -135,6 +135,9 @@ def add_data_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--num_workers", type=int, default=4)
     parser.add_argument("--synthetic_ok", action="store_true",
                         help="fall back to procedural data if roots missing")
+    parser.add_argument("--synthetic_style", default=d.synthetic_style,
+                        choices=["smooth", "rigid"],
+                        help="procedural generator for the fallback")
 
 
 def add_train_args(parser: argparse.ArgumentParser) -> None:
@@ -259,6 +262,7 @@ def data_config_from_args(args: argparse.Namespace) -> DataConfig:
         compressed_ft=args.compressed_ft,
         num_workers=args.num_workers,
         synthetic_ok=args.synthetic_ok,
+        synthetic_style=args.synthetic_style,
     )
 
 

@@ -184,6 +184,10 @@ class DataConfig:
     # When no dataset is present on disk, the loader can serve procedurally
     # generated pairs so training/benchmarking still exercises the full path.
     synthetic_ok: bool = False
+    # Procedural generator: "smooth" (dense smooth flow) or "rigid"
+    # (piecewise-rigid scenes with sharp motion boundaries + occlusion —
+    # the split that can separate NCUP from bilinear upsampling).
+    synthetic_style: str = "smooth"
 
 
 def _to_jsonable(obj: Any) -> Any:

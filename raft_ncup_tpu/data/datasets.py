@@ -254,7 +254,9 @@ def fetch_training_set(
     if len(ds) == 0 and cfg.synthetic_ok:
         from raft_ncup_tpu.data.synthetic import SyntheticFlowDataset
 
-        return SyntheticFlowDataset(tuple(image_size), length=512)
+        return SyntheticFlowDataset(
+            tuple(image_size), length=512, style=cfg.synthetic_style
+        )
     return ds
 
 

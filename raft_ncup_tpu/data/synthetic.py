@@ -1,10 +1,22 @@
 """Procedural flow pairs for tests and data-free benchmarking.
 
-Generates a random textured image, a smooth random flow field, and the
-backward-warped second frame; the pair is a consistent (image1, image2,
-flow) training sample without any dataset on disk. Used when
-``DataConfig.synthetic_ok`` is set and the requested dataset roots are
-absent, so the full train loop stays exercisable anywhere.
+Two generators, selected by ``style``:
+
+- ``"smooth"`` — a random textured image, a smooth random flow field,
+  and the backward-warped second frame. Cheap and fully dense, but the
+  flow has no discontinuities by construction.
+- ``"rigid"`` — a piecewise-rigid scene: a background plus 2-4 textured
+  shapes, each with its own similarity motion (rotation/scale/shift).
+  Both frames are rendered independently from the surface parameters
+  (the FlyingChairs recipe — reference: core/datasets.py:169-186 only
+  *loads* such data; here it is generated), so the ground-truth flow is
+  exact, sharply discontinuous at shape boundaries, and includes real
+  occlusion. This is the split that can distinguish guided (NCUP)
+  upsampling from naive bilinear: the paper's gains live at motion
+  boundaries (reference: core/upsampler.py:75-210, README.md:11).
+
+Used when ``DataConfig.synthetic_ok`` is set and the requested dataset
+roots are absent, so the full train loop stays exercisable anywhere.
 """
 
 from __future__ import annotations
@@ -25,6 +37,12 @@ def _smooth_noise(rng, shape_hw, scale: int, channels: int) -> np.ndarray:
     ).reshape(h, w, channels)
 
 
+def _norm255(t: np.ndarray) -> np.ndarray:
+    """Normalize a texture to [0, 255] once, so both frames sampling it
+    stay photometrically consistent."""
+    return (t - t.min()) / (np.ptp(t) + 1e-6) * 255.0
+
+
 def make_pair(
     rng: np.random.Generator,
     size_hw: tuple[int, int],
@@ -32,9 +50,7 @@ def make_pair(
 ) -> dict:
     """One synthetic sample: textured frame, smooth flow, warped frame."""
     h, w = size_hw
-    img1 = _smooth_noise(rng, (h, w), 8, 3)
-    img1 = (img1 - img1.min()) / (np.ptp(img1) + 1e-6) * 255.0
-    img1 = img1.astype(np.uint8)
+    img1 = _norm255(_smooth_noise(rng, (h, w), 8, 3)).astype(np.uint8)
 
     flow = _smooth_noise(rng, (h, w), 32, 2) * (max_mag / 2.0)
     flow = flow.astype(np.float32)
@@ -57,6 +73,114 @@ def make_pair(
     }
 
 
+class _Similarity:
+    """2D similarity motion ``M(p) = s·R(p-c) + c + d`` (vectorized)."""
+
+    def __init__(self, center, angle: float, scale: float, shift):
+        self.c = np.asarray(center, np.float32)
+        self.d = np.asarray(shift, np.float32)
+        cos, sin = np.cos(angle) * scale, np.sin(angle) * scale
+        self.A = np.array([[cos, -sin], [sin, cos]], np.float32)
+        self.Ainv = np.linalg.inv(self.A).astype(np.float32)
+
+    def forward(self, pts: np.ndarray) -> np.ndarray:
+        return (pts - self.c) @ self.A.T + self.c + self.d
+
+    def inverse(self, pts: np.ndarray) -> np.ndarray:
+        return (pts - self.c - self.d) @ self.Ainv.T + self.c
+
+
+def _sample_tex(tex: np.ndarray, pts: np.ndarray) -> np.ndarray:
+    """Bilinear-sample an (H, W, C) texture at (H, W, 2) xy points."""
+    return cv2.remap(
+        tex, pts[..., 0], pts[..., 1], cv2.INTER_LINEAR,
+        borderMode=cv2.BORDER_REFLECT,
+    )
+
+
+def make_rigid_pair(
+    rng: np.random.Generator,
+    size_hw: tuple[int, int],
+    max_mag: float = 12.0,
+    n_shapes: tuple[int, int] = (2, 4),
+) -> dict:
+    """One piecewise-rigid sample: 2-4 moving textured shapes over a
+    moving background, both frames rendered from the surface parameters,
+    flow exact everywhere (including occluded pixels, as in Sintel GT).
+    """
+    h, w = size_hw
+    xx, yy = np.meshgrid(np.arange(w, dtype=np.float32),
+                         np.arange(h, dtype=np.float32))
+    pts = np.stack([xx, yy], axis=-1)  # (h, w, 2) xy
+
+    def motion(max_shift, max_rot_deg, max_log_scale, center):
+        ang = np.deg2rad(rng.uniform(-max_rot_deg, max_rot_deg))
+        s = np.exp(rng.uniform(-max_log_scale, max_log_scale))
+        theta = rng.uniform(0, 2 * np.pi)
+        r = rng.uniform(0.25, 1.0) * max_shift
+        return _Similarity(center, ang, s,
+                           (r * np.cos(theta), r * np.sin(theta)))
+
+    # Background: its own (small) similarity motion about the image center.
+    bg_tex = _norm255(_smooth_noise(rng, (h, w), 8, 3))
+    bg_m = motion(max_mag / 4.0, 2.0, 0.02, ((w - 1) / 2.0, (h - 1) / 2.0))
+    img1 = bg_tex.copy()
+    img2 = _sample_tex(bg_tex, bg_m.inverse(pts))
+    flow = (bg_m.forward(pts) - pts).astype(np.float32)
+
+    # Shapes, painted back-to-front; the frame-1 mask overwrites the flow,
+    # so the topmost surface wins exactly where it is visible in frame 1.
+    for _ in range(rng.integers(n_shapes[0], n_shapes[1] + 1)):
+        c = np.array([rng.uniform(0.2 * w, 0.8 * w),
+                      rng.uniform(0.2 * h, 0.8 * h)], np.float32)
+        ax = rng.uniform(0.10, 0.28, size=2) * min(h, w)
+        th = rng.uniform(0, np.pi)
+        rect = rng.random() < 0.4
+
+        def inside(p, c=c, ax=ax, th=th, rect=rect):
+            loc = (p - c) @ np.array(
+                [[np.cos(th), np.sin(th)], [-np.sin(th), np.cos(th)]],
+                np.float32,
+            ).T
+            u, v = loc[..., 0] / ax[0], loc[..., 1] / ax[1]
+            return (np.maximum(np.abs(u), np.abs(v)) <= 1.0 if rect
+                    else u * u + v * v <= 1.0)
+
+        tex = _norm255(_smooth_noise(rng, (h, w), int(rng.choice([4, 8])), 3))
+        m = motion(0.85 * max_mag, 8.0, 0.05, c)
+
+        mask1 = inside(pts)
+        img1[mask1] = tex[mask1]
+        flow[mask1] = (m.forward(pts) - pts)[mask1]
+
+        back = m.inverse(pts)  # frame-2 pixel -> frame-1 surface point
+        mask2 = inside(back)
+        img2[mask2] = _sample_tex(tex, back)[mask2]
+
+    valid = np.ones((h, w), np.float32)
+    return {
+        "image1": np.clip(img1, 0, 255).astype(np.uint8),
+        "image2": np.clip(img2, 0, 255).astype(np.uint8),
+        "flow": flow.astype(np.float32),
+        "valid": valid,
+    }
+
+
+def flow_boundary_mask(
+    flow: np.ndarray, thresh: float = 2.0, band_px: int = 3
+) -> np.ndarray:
+    """Boolean mask of pixels within ``band_px`` of a flow discontinuity
+    (forward-difference gradient magnitude above ``thresh`` px). The
+    boundary-band EPE over this mask is the metric on which guided
+    upsampling is expected to beat bilinear (reference claim:
+    core/upsampler.py:75-210)."""
+    gx = np.abs(np.diff(flow, axis=1, append=flow[:, -1:])).sum(-1)
+    gy = np.abs(np.diff(flow, axis=0, append=flow[-1:])).sum(-1)
+    edge = ((gx + gy) > thresh).astype(np.uint8)
+    k = np.ones((2 * band_px + 1, 2 * band_px + 1), np.uint8)
+    return cv2.dilate(edge, k).astype(bool)
+
+
 class SyntheticFlowDataset:
     """Fixed-length procedural dataset compatible with FlowLoader."""
 
@@ -66,11 +190,15 @@ class SyntheticFlowDataset:
         length: int = 512,
         seed: int = 0,
         max_mag: float = 12.0,
+        style: str = "smooth",
     ):
+        if style not in ("smooth", "rigid"):
+            raise ValueError(f"unknown synthetic style: {style!r}")
         self.size_hw = tuple(size_hw)
         self.length = length
         self.seed = seed
         self.max_mag = max_mag
+        self.style = style
         self.is_test = False
 
     def __len__(self) -> int:
@@ -82,4 +210,5 @@ class SyntheticFlowDataset:
         gen = np.random.default_rng(
             np.random.SeedSequence([self.seed, int(index)])
         )
-        return make_pair(gen, self.size_hw, self.max_mag)
+        make = make_rigid_pair if self.style == "rigid" else make_pair
+        return make(gen, self.size_hw, self.max_mag)

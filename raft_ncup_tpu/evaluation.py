@@ -343,18 +343,33 @@ def create_kitti_submission(
 def validate_synthetic(
     model: RAFT, variables: dict, data_cfg: Optional[DataConfig] = None,
     iters: int = 12, batch_size: int = 4, size_hw: tuple[int, int] = (96, 128),
-    length: int = 32, mesh=None,
+    length: int = 32, mesh=None, style: Optional[str] = None,
 ) -> dict:
     """EPE on a HELD-OUT procedural split (seed distinct from the
     training fallback's seed=0) so data-free runs (`--synthetic_ok`,
     `--validation synthetic`) get a genuine generalization signal, not a
     training-set echo. No reference analogue — the reference always
-    validates on real datasets (evaluate.py:90-182)."""
-    from raft_ncup_tpu.data.synthetic import SyntheticFlowDataset
+    validates on real datasets (evaluate.py:90-182).
 
-    dataset = SyntheticFlowDataset(size_hw, length=length, seed=999)
+    ``style`` defaults to the training distribution
+    (``data_cfg.synthetic_style``) so `--validation synthetic` measures
+    generalization on the data the run trained on. ``style="rigid"``
+    additionally reports a boundary-band EPE (pixels within 3 px of a
+    flow discontinuity) and its complement — the metric pair on which
+    guided (NCUP) upsampling is expected to beat bilinear (reference
+    claim: core/upsampler.py:75-210)."""
+    from raft_ncup_tpu.data.synthetic import (
+        SyntheticFlowDataset,
+        flow_boundary_mask,
+    )
+
+    if style is None:
+        style = data_cfg.synthetic_style if data_cfg else "smooth"
+    prefix = "synthetic" if style == "smooth" else f"synthetic_{style}"
+    dataset = SyntheticFlowDataset(size_hw, length=length, seed=999,
+                                   style=style)
     fwd = _ShapeCachedForward(model, variables, mesh=mesh)
-    epe_list = []
+    epe_list, bnd_list, interior_list = [], [], []
     for group in _uniform_batches(dataset, batch_size):
         img1 = np.stack([s["image1"] for s in group]).astype(np.float32)
         img2 = np.stack([s["image2"] for s in group]).astype(np.float32)
@@ -362,9 +377,36 @@ def validate_synthetic(
         for k, s in enumerate(group):
             epe = np.sqrt(((np.asarray(flow_up[k]) - s["flow"]) ** 2).sum(-1))
             epe_list.append(epe.ravel())
+            if style == "rigid":
+                band = flow_boundary_mask(s["flow"])
+                bnd_list.append(epe[band])
+                interior_list.append(epe[~band])
     epe = float(np.concatenate(epe_list).mean())
-    print(f"Validation Synthetic EPE: {epe:f}")
-    return {"synthetic": epe}
+    out = {prefix: epe}
+    if bnd_list:
+        out[f"{prefix}_bnd"] = float(np.concatenate(bnd_list).mean())
+        out[f"{prefix}_interior"] = float(
+            np.concatenate(interior_list).mean()
+        )
+        print(
+            f"Validation Synthetic[{style}] EPE: {epe:f}, "
+            f"boundary: {out[f'{prefix}_bnd']:f}, "
+            f"interior: {out[f'{prefix}_interior']:f}"
+        )
+    else:
+        print(f"Validation Synthetic EPE: {epe:f}")
+    return out
+
+
+def validate_synthetic_rigid(
+    model: RAFT, variables: dict, data_cfg: Optional[DataConfig] = None,
+    **kwargs,
+) -> dict:
+    """Held-out piecewise-rigid split with boundary-band EPE (see
+    :func:`validate_synthetic`)."""
+    return validate_synthetic(
+        model, variables, data_cfg, style="rigid", **kwargs
+    )
 
 
 VALIDATORS = {
@@ -372,4 +414,5 @@ VALIDATORS = {
     "sintel": validate_sintel,
     "kitti": validate_kitti,
     "synthetic": validate_synthetic,
+    "synthetic_rigid": validate_synthetic_rigid,
 }

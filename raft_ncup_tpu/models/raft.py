@@ -123,7 +123,9 @@ class RAFT:
             flow2 = jnp.zeros((B, h8 * 2, w8 * 2, 2), jnp.float32)
             guidance = jnp.zeros((B, h8, w8, hdim), jnp.float32)
             vup = self.upsampler.init(kup, flow2, guidance)
-            params["upsampler"] = vup["params"]
+            # Parameter-free heads (bilinear) init to an empty group so the
+            # apply-side scoping stays uniform across upsampler kinds.
+            params["upsampler"] = vup.get("params", {})
             if "batch_stats" in vup:
                 batch_stats["upsampler"] = vup["batch_stats"]
 
@@ -181,7 +183,14 @@ class RAFT:
         img2 = 2.0 * (image2 / 255.0) - 1.0
 
         def run(name, module, *args, **kwargs):
-            v = {"params": params[name]}
+            # Only the upsampler may be parameter-free (bilinear head): its
+            # empty group gets dropped by flatten/unflatten round-trips
+            # (checkpoint merge). For every other submodule absence is a
+            # truncated checkpoint and must keep failing loudly.
+            if name == "upsampler":
+                v = {"params": params.get(name, {})}
+            else:
+                v = {"params": params[name]}
             if name in bstats:
                 v["batch_stats"] = bstats[name]
             if bn_train and name in bstats:
