@@ -32,9 +32,9 @@ def load_variables(model, model_cfg, restore_ckpt: str | None):
     if not restore_ckpt:
         return variables
     if os.path.isdir(restore_ckpt):
-        from raft_ncup_tpu.training.checkpoint import _restore_variables_only
+        from raft_ncup_tpu.training.checkpoint import restore_variables
 
-        restored = _restore_variables_only(restore_ckpt)
+        restored = restore_variables(restore_ckpt)
         variables["params"] = restored["params"]
         if "batch_stats" in restored:
             variables["batch_stats"] = restored["batch_stats"]

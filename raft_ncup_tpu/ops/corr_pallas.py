@@ -84,7 +84,11 @@ def reset_dispatch_counts() -> None:
 
 def dispatch_counts() -> dict:
     """Copy of the per-level dispatch tally since the last reset (counts
-    trace-time decisions, one per pyramid level per compile)."""
+    trace-time decisions, one per pyramid level per TRACE — a custom_vjp
+    backward trace, a shape-driven retrace, or a concurrent thread each
+    add their own tallies, so the counts are only interpretable between
+    a reset and a single lowering in a single thread, the discipline
+    bench.py follows)."""
     return dict(_dispatch_counts)
 
 

@@ -38,7 +38,10 @@ def reset_dispatch_counts() -> None:
 def dispatch_counts() -> dict:
     """Copy of the {'fused', 'fallback'} tally since the last reset.
     Counts trace-time decisions (one per distinct nconv2d call site per
-    compile), not runtime executions."""
+    TRACE), not runtime executions — extra traces in the same process
+    (custom_vjp backward, retraces, concurrent threads) inflate the
+    tally, so values are only interpretable between a reset and a single
+    lowering in a single thread (bench.py's discipline)."""
     return dict(_dispatch_counts)
 
 

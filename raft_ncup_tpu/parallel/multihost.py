@@ -13,6 +13,7 @@ host feeds its disjoint input shard (FlowLoader already shards by
 
 from __future__ import annotations
 
+import sys
 from typing import Optional
 
 import jax
@@ -99,7 +100,13 @@ def barrier(name: str, timeout_s: float = 480.0) -> bool:
         # TypeError included: the unstable jax._src signature changing
         # (e.g. the timeout keyword renamed) must degrade like the API
         # being absent, per this helper's contract.
-        print(f"multihost barrier unavailable ({e}); proceeding unaligned")
+        # stderr: child stdout is a parsed protocol stream in the tooling
+        # around this helper (tests/_distributed_child.py's LOSS= lines,
+        # bench.py's JSON-tail harvest) — diagnostics must not mix in.
+        print(
+            f"multihost barrier unavailable ({e}); proceeding unaligned",
+            file=sys.stderr,
+        )
         return False
 
 

@@ -100,7 +100,7 @@ def load_pretrained_trunk(path: str, variables: dict) -> dict:
     nothing raise.
     """
     if os.path.isdir(path):
-        restored = _restore_variables_only(path)
+        restored = restore_variables(path)
         return _merge_trunk(restored, variables)
     # Stock RAFT checkpoints carry the convex-mask head; a raft_nc_dbl
     # destination deletes it (reference loads *then* deletes,
@@ -115,7 +115,10 @@ def load_pretrained_trunk(path: str, variables: dict) -> dict:
     )
 
 
-def _restore_variables_only(directory: str) -> dict:
+def restore_variables(directory: str) -> dict:
+    """Load just the model variables ({params[, batch_stats]}) from an
+    orbax run directory's latest step — the eval-side restore (no
+    optimizer state, no TrainState structure needed)."""
     mgr = ocp.CheckpointManager(os.path.abspath(directory))
     step = mgr.latest_step()
     if step is None:

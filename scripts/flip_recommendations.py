@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Data-driven kernel-default recommendations from a bench record.
+
+Reads one bench.py JSON record (file argument or stdin) and prints which
+implementation defaults the measurements support flipping:
+
+- ``ModelConfig.corr_impl`` (raft_ncup_tpu/config.py) — 'volume' vs
+  'onthefly' vs 'pallas' (reference hot path: core/corr.py:13-44);
+- ``RAFT_NCUP_NCONV_IMPL`` (raft_ncup_tpu/ops/nconv.py) — 'xla' vs the
+  fused Pallas NConv kernel.
+
+Defaults only flip on ACCELERATOR data: CPU rows order kernels by how
+well they suit a host CPU, not the MXU/VMEM tradeoffs the kernels were
+built around (docs/PERF.md: volume beats onthefly on CPU at the small
+shape for exactly this reason).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+MARGIN = 1.03  # >=3% win required to recommend changing a default
+
+
+def recommend(record: dict) -> list[str]:
+    lines = []
+    key = str(record.get("baseline_key", ""))
+    if key.startswith("cpu") or not key:
+        return [
+            "no accelerator measurement in this record "
+            f"(baseline_key={key or 'absent'!r}); defaults stay "
+            "corr_impl='volume', RAFT_NCUP_NCONV_IMPL='xla' pending TPU data"
+        ]
+
+    corr = {"volume": record.get("value")}
+    for tag in ("onthefly", "pallas"):
+        v = record.get(f"pairs_per_sec_{tag}")
+        if v:
+            corr[tag] = v
+    corr = {k: v for k, v in corr.items() if v}
+    best = max(corr, key=corr.get)
+    if len(corr) < 2:
+        lines.append(
+            f"corr_impl: only {list(corr)} measured; no comparison possible"
+        )
+    elif best != "volume" and corr[best] >= MARGIN * corr.get("volume", 0):
+        lines.append(
+            f"corr_impl: FLIP default 'volume' -> '{best}' "
+            f"({corr[best]:.2f} vs {corr['volume']:.2f} pairs/s; "
+            "edit raft_ncup_tpu/config.py ModelConfig.corr_impl)"
+        )
+    else:
+        lines.append(
+            f"corr_impl: keep 'volume' ({ {k: round(v, 2) for k, v in corr.items()} })"
+        )
+
+    nc = record.get("pairs_per_sec_nconv_pallas")
+    fell_back = record.get("pairs_per_sec_nconv_pallas_FELL_BACK_TO_XLA")
+    base = record.get("value")
+    if nc and base:
+        if nc >= MARGIN * base:
+            lines.append(
+                f"nconv: FLIP default 'xla' -> 'pallas' ({nc:.2f} vs "
+                f"{base:.2f} pairs/s; edit raft_ncup_tpu/ops/nconv.py "
+                "RAFT_NCUP_NCONV_IMPL default)"
+            )
+        else:
+            lines.append(
+                f"nconv: keep 'xla' (pallas {nc:.2f} vs xla {base:.2f} pairs/s)"
+            )
+    elif fell_back:
+        lines.append(
+            "nconv: pallas row fell back to XLA at this shape "
+            f"({fell_back:.2f} pairs/s) — no fused measurement; keep 'xla'"
+        )
+    else:
+        lines.append("nconv: no pallas row measured; keep 'xla'")
+    return lines
+
+
+def main() -> None:
+    src = open(sys.argv[1]) if len(sys.argv) > 1 else sys.stdin
+    text = src.read().strip()
+    # Accept either a bare record or bench stdout whose LAST line is JSON.
+    record = json.loads(text.splitlines()[-1])
+    print("kernel-default recommendations:")
+    for line in recommend(record):
+        print("  - " + line)
+
+
+if __name__ == "__main__":
+    main()

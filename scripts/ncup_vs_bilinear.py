@@ -105,8 +105,8 @@ def main() -> None:
     from raft_ncup_tpu.evaluation import validate_synthetic_rigid
     from raft_ncup_tpu.models import get_model
     from raft_ncup_tpu.training.checkpoint import (
-        _restore_variables_only,
         load_pretrained_trunk,
+        restore_variables,
     )
 
     eval_kw = dict(iters=12, batch_size=4, size_hw=(96, 128),
@@ -117,7 +117,7 @@ def main() -> None:
         _, model_cfg, _, _ = parse_train(train_argv(a, twin))
         model = get_model(model_cfg)
         if twin == "ncup":
-            variables = _restore_variables_only(ncup_dir)
+            variables = restore_variables(ncup_dir)
         else:
             # Parameter-free head: the frozen trunk IS the whole model.
             variables = model.init(jax.random.PRNGKey(0), (1, 64, 96, 3))
