@@ -55,11 +55,31 @@ def recommend(record: dict) -> list[str]:
             f"corr_impl: keep 'volume' ({ {k: round(v, 2) for k, v in corr.items()} })"
         )
 
+    if "corr_pallas_levels" in record and "pallas" in corr:
+        lines.append(
+            f"corr: note — pallas row ran the kernel on "
+            f"{record['corr_pallas_levels']} pyramid levels (per-level "
+            "VMEM gating; partial dispatch is by design at large shapes)"
+        )
+
     nc = record.get("pairs_per_sec_nconv_pallas")
     fell_back = record.get("pairs_per_sec_nconv_pallas_FELL_BACK_TO_XLA")
     base = record.get("value")
+    calls = str(record.get("nconv_pallas_calls", ""))
+    partial = False
+    if calls and "/" in calls:
+        fused_n, total_n = (int(x) for x in calls.split("/"))
+        partial = fused_n < total_n
     if nc and base:
-        if nc >= MARGIN * base:
+        if partial:
+            # A mostly-XLA measurement must not flip the default on a
+            # small margin — the number's provenance is mixed.
+            lines.append(
+                f"nconv: pallas row only PARTIALLY fused ({calls} call "
+                f"sites; {nc:.2f} vs {base:.2f} pairs/s) — do NOT flip on "
+                "this row; investigate the gated-out call sites first"
+            )
+        elif nc >= MARGIN * base:
             lines.append(
                 f"nconv: FLIP default 'xla' -> 'pallas' ({nc:.2f} vs "
                 f"{base:.2f} pairs/s; edit raft_ncup_tpu/ops/nconv.py "
@@ -82,8 +102,21 @@ def recommend(record: dict) -> list[str]:
 def main() -> None:
     src = open(sys.argv[1]) if len(sys.argv) > 1 else sys.stdin
     text = src.read().strip()
+    if not text:
+        print(
+            "flip_recommendations: no input (bench produced no record?)",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
     # Accept either a bare record or bench stdout whose LAST line is JSON.
-    record = json.loads(text.splitlines()[-1])
+    try:
+        record = json.loads(text.splitlines()[-1])
+    except ValueError as e:
+        print(
+            f"flip_recommendations: last input line is not JSON ({e})",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
     print("kernel-default recommendations:")
     for line in recommend(record):
         print("  - " + line)

@@ -23,21 +23,16 @@ cd "$(dirname "$0")/.."
 
 LOGFILE=docs/tpu_probe_log.md
 if [ ! -f "$LOGFILE" ]; then
-    cat > "$LOGFILE" <<'EOF'
-# TPU probe log
-
-Every `scripts/tpu_ritual.sh` attempt to reach the axon TPU tunnel, in
-order. The bounded probe runs `jax.devices()` in a watchdogged child
-(`raft_ncup_tpu/utils/backend_probe.py`) because the wedged tunnel HANGS
-rather than failing fast (docs/PERF.md round-4 postmortem).
-
-| when (UTC) | duration | platform | outcome | follow-up |
-|---|---|---|---|---|
-EOF
+    # Bootstrap only (the committed docs/tpu_probe_log.md is the
+    # authoritative copy, header documentation included).
+    printf '# TPU probe log\n\nSee scripts/tpu_ritual.sh.\n\n| when (UTC) | duration | platform | outcome | follow-up |\n|---|---|---|---|---|\n' > "$LOGFILE"
 fi
 
 TS=$(date -u +"%Y-%m-%dT%H:%M:%SZ")
-PROBE_OUT=$(python - <<'EOF'
+# Take only the LAST line (stray jax/absl stdout noise must not corrupt
+# the parsed fields), and fail loudly on an empty/failed probe script —
+# a malformed audit row would defeat the log's purpose.
+PROBE_OUT=$(python - <<'EOF' | tail -1
 import os, time
 from raft_ncup_tpu.utils.backend_probe import probe_backend
 t0 = time.time()
@@ -48,23 +43,43 @@ EOF
 DUR=$(echo "$PROBE_OUT" | cut -d'|' -f1)
 PLATFORM=$(echo "$PROBE_OUT" | cut -d'|' -f2)
 REASON=$(echo "$PROBE_OUT" | cut -d'|' -f3)
+if [ -z "$DUR" ] || [ -z "$PLATFORM" ] || [ -z "$REASON" ]; then
+    echo "ritual: probe script failed (output: '$PROBE_OUT')" >&2
+    echo "| $TS | - | - | probe-script-error | none |" >> "$LOGFILE"
+    exit 1
+fi
 echo "probe: platform=$PLATFORM reason=$REASON after $DUR"
 
 if [ "$REASON" = "ok" ] && [ "$PLATFORM" != "cpu" ] && [ "$PLATFORM" != "-" ]; then
+    # Evidence must survive the session: /tmp dies with the host, so the
+    # banked record/recommendations/test-tail go into the committed log.
+    EVDIR=docs/tpu_evidence
+    mkdir -p "$EVDIR"
+    STAMP=$(echo "$TS" | tr ':' '-')
     FOLLOWUP=""
     echo "== live accelerator ($PLATFORM): running tests_tpu/"
-    if python -m pytest tests_tpu/ -q -rs 2>&1 | tee /tmp/ritual_tests.log | tail -3; then
+    if python -m pytest tests_tpu/ -q -rs 2>&1 | tee "$EVDIR/tests_$STAMP.log" | tail -3; then
         FOLLOWUP="tests_tpu green; "
     else
-        FOLLOWUP="tests_tpu FAILED (see /tmp/ritual_tests.log); "
+        FOLLOWUP="tests_tpu FAILED; "
     fi
     echo "== running bench.py (full shape + variant rows)"
-    python bench.py 2> >(tail -5 >&2) | tee /tmp/ritual_bench.out | tail -1
-    if tail -1 /tmp/ritual_bench.out | python scripts/flip_recommendations.py; then
-        FOLLOWUP="${FOLLOWUP}bench row recorded (see docs/perf_baseline.json)"
+    python bench.py 2> >(tail -5 >&2) | tee "$EVDIR/bench_$STAMP.out" | tail -1
+    if tail -1 "$EVDIR/bench_$STAMP.out" | python scripts/flip_recommendations.py \
+        | tee "$EVDIR/flips_$STAMP.txt"; then
+        FOLLOWUP="${FOLLOWUP}bench row recorded (docs/perf_baseline.json, $EVDIR/)"
     fi
-    echo "| $TS | $DUR | $PLATFORM | live | $FOLLOWUP |" >> "$LOGFILE"
-    echo "== evidence banked. Append the bench row + recommendations to docs/PERF.md."
+    {
+        echo "| $TS | $DUR | $PLATFORM | live | $FOLLOWUP |"
+        echo
+        echo "Evidence $TS:"
+        echo
+        echo '```'
+        tail -1 "$EVDIR/bench_$STAMP.out"
+        cat "$EVDIR/flips_$STAMP.txt" 2>/dev/null
+        echo '```'
+    } >> "$LOGFILE"
+    echo "== evidence banked in $EVDIR/ and $LOGFILE; commit these files."
 else
     echo "| $TS | $DUR | $PLATFORM | $REASON | none (no accelerator) |" >> "$LOGFILE"
     echo "== tunnel not available ($REASON); attempt logged in $LOGFILE"
