@@ -57,6 +57,16 @@ def main(argv=None) -> None:
     model = RAFT(model_cfg)
     variables = load_variables(model, model_cfg, args.restore_ckpt)
 
+    if args.export_pth:
+        # Serialize the loaded checkpoint as a reference-keyed .pth the
+        # reference's strict DataParallel eval load consumes directly
+        # (reference: evaluate.py:246-257).
+        from raft_ncup_tpu.utils.torch_export import save_torch_checkpoint
+
+        save_torch_checkpoint(args.export_pth, variables)
+        print(f"exported reference-keyed checkpoint to {args.export_pth}")
+        return
+
     mesh = None
     if args.spatial_parallel > 1:
         from raft_ncup_tpu.parallel.mesh import make_mesh

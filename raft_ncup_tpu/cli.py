@@ -290,6 +290,9 @@ def build_eval_parser() -> argparse.ArgumentParser:
     parser.add_argument("--warm_start", action="store_true")
     parser.add_argument("--write_png", action="store_true")
     parser.add_argument("--output_path", default=None)
+    parser.add_argument("--export_pth", default=None, metavar="PATH",
+                        help="write the loaded checkpoint as a reference-"
+                             "keyed PyTorch .pth and exit")
     parser.add_argument("--spatial_parallel", type=int, default=1,
                         help="shard eval height over this many devices "
                         "(high-res inference; pairs with --corr_impl "

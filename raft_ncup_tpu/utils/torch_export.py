@@ -1,0 +1,214 @@
+"""Export our parameter trees as reference-keyed PyTorch state dicts.
+
+The inverse of :mod:`raft_ncup_tpu.utils.torch_import`: given model
+variables from ``RAFT.init`` / a trained checkpoint, produce the exact
+``{torch key: numpy array}`` mapping the PyTorch reference's STRICT
+``load_state_dict`` expects (reference: evaluate.py:257 loads a
+DataParallel-wrapped model — keys prefixed ``module.`` — with
+``strict=True``), so checkpoints trained here drop into the reference
+the day real hardware/data exist (VERDICT r4 #5).
+
+Strictness is the hard part: beyond inverting the module-path
+translation and the HWIO→OIHW layout, the export must *regenerate* every
+key the import deliberately skips:
+
+- ``num_batches_tracked`` for each BatchNorm (zeros — the reference
+  never consults it with ``track_running_stats`` defaults at eval);
+- the residual-block duplicate norm (the downsample norm is registered
+  both as ``normN`` and ``downsample.1`` — reference:
+  core/extractor.py:44-45,103-104);
+- the NConvUNet shared-encoder aliases (``encoder.0.0`` = ``nconv_in``,
+  ``encoder.0.1.K`` = ``nconv_x2.K``, ``encoder.J`` = ``nconv_x2.0`` for
+  J>=1 under ``shared_encoder`` — reference: core/nconv_modules.py:76-83).
+
+Like the import, this module has no torch dependency; the caller saves
+with ``torch.save`` (or :func:`save_torch_checkpoint` which does it for
+you when torch is available).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import numpy as np
+from flax import traverse_util
+
+_NORM_WRAPPERS = ("BatchNorm_0", "GroupNorm_0")
+
+
+def _untranslate_segment(seg: str, in_weights_est: bool) -> list[str]:
+    """Inverse of torch_import._translate_module_path, one flax segment
+    to torch dotted segments."""
+    m = re.fullmatch(r"layer(\d+)_(\d+)", seg)
+    if m:
+        return [f"layer{m.group(1)}", m.group(2)]
+    if seg == "downsample_conv":
+        return ["downsample", "0"]
+    if seg == "downsample_norm":
+        return ["downsample", "1"]
+    if seg == "mask_conv1":
+        return ["mask", "0"]
+    if seg == "mask_conv2":
+        return ["mask", "2"]
+    for name in ("nconv_x2", "decoder", "encoder"):
+        m = re.fullmatch(rf"{name}_(\d+)", seg)
+        if m:
+            return [name, m.group(1)]
+    if in_weights_est:
+        # The Simple weights-est net is a Sequential of (conv, bn) pairs
+        # (torch conv.N.0 / conv.N.1). Context-gated: plain residual-block
+        # convN must stay convN.
+        m = re.fullmatch(r"conv(\d+)", seg)
+        if m:
+            return ["conv", m.group(1), "0"]
+        m = re.fullmatch(r"bn(\d+)", seg)
+        if m:
+            return ["conv", m.group(1), "1"]
+    return [seg]
+
+
+def _torch_module_path(flax_path: tuple[str, ...]) -> str:
+    in_we = "weights_est_net" in flax_path
+    out: list[str] = []
+    for seg in flax_path:
+        out.extend(_untranslate_segment(seg, in_we))
+    return ".".join(out)
+
+
+def _export_kernel(val: np.ndarray) -> np.ndarray:
+    v = np.asarray(val, np.float32)
+    if v.ndim == 4:
+        return v.transpose(3, 2, 0, 1)  # HWIO -> OIHW (inverse of import)
+    return v
+
+
+def export_torch_state(variables: dict) -> dict[str, Any]:
+    """Build the reference-keyed state dict (no ``module.`` prefix; see
+    :func:`save_torch_checkpoint` for the DataParallel form)."""
+    params = traverse_util.flatten_dict(variables.get("params", {}))
+    stats = traverse_util.flatten_dict(variables.get("batch_stats", {}))
+    out: dict[str, Any] = {}
+
+    for key, val in params.items():
+        *mod, leaf = key
+        mod = tuple(mod)
+        if mod and mod[-1] in _NORM_WRAPPERS:
+            base = _torch_module_path(mod[:-1])
+            name = {"scale": "weight", "bias": "bias"}[leaf]
+            out[f"{base}.{name}"] = np.asarray(val, np.float32)
+            continue
+        base = _torch_module_path(mod)
+        if leaf == "kernel":
+            out[f"{base}.weight"] = _export_kernel(val)
+        elif leaf == "weight_p":
+            # NConv2d's positive conv weight: conv-shaped, so the same
+            # HWIO->OIHW transpose as 'kernel' (the import transposes any
+            # 4-d weight/weight_p).
+            out[f"{base}.weight_p"] = _export_kernel(val)
+        else:  # bias and any future verbatim leaf
+            out[f"{base}.{leaf}"] = np.asarray(val, np.float32)
+
+    norm_paths = set()
+    for key, val in stats.items():
+        *mod, leaf = key
+        mod = tuple(mod)
+        if mod and mod[-1] in _NORM_WRAPPERS:
+            mod = mod[:-1]
+        base = _torch_module_path(mod)
+        name = {"mean": "running_mean", "var": "running_var"}[leaf]
+        out[f"{base}.{name}"] = np.asarray(val, np.float32)
+        norm_paths.add(base)
+    for base in norm_paths:
+        # torch BatchNorm2d registers the step counter as a buffer; the
+        # strict load requires the key, eval never reads the value.
+        out[f"{base}.num_batches_tracked"] = np.asarray(0, np.int64)
+
+    _add_resblock_norm_duplicates(params, out)
+    _add_shared_encoder_aliases(params, out)
+    return out
+
+
+def _add_resblock_norm_duplicates(params: dict, out: dict) -> None:
+    """Residual blocks register the downsample norm twice: ``normN`` and
+    ``downsample.1`` (reference: core/extractor.py:44-45,103-104). N is
+    one past the block's conv count (BasicBlock: norm3, Bottleneck:
+    norm4)."""
+    blocks = {
+        key[:-3]
+        for key in params
+        if len(key) >= 3 and key[-3] == "downsample_norm"
+    }
+    for block in blocks:
+        convs = [
+            int(re.fullmatch(r"conv(\d+)", k[len(block)]).group(1))
+            for k in params
+            if len(k) > len(block)
+            and k[: len(block)] == block
+            and re.fullmatch(r"conv(\d+)", k[len(block)])
+        ]
+        if not convs:
+            continue
+        dup = f"norm{max(convs) + 1}"
+        src = _torch_module_path(block + ("downsample_norm",))
+        dst = _torch_module_path(block + (dup,))
+        for key in list(out):
+            if key.startswith(src + "."):
+                out[dst + key[len(src):]] = out[key]
+
+
+def _add_shared_encoder_aliases(params: dict, out: dict) -> None:
+    """NConvUNet registers its encoder stages as aliases of nconv_in /
+    nconv_x2 (reference: core/nconv_modules.py:76-83); a strict torch
+    load expects those duplicate keys."""
+    nets = {
+        key[: key.index("interpolation_net") + 1]
+        for key in params
+        if "interpolation_net" in key
+    }
+    for net in nets:
+        sub = {k[len(net):]: k for k in params if k[: len(net)] == net}
+        x2_idx = sorted(
+            {
+                int(re.fullmatch(r"nconv_x2_(\d+)", k[0]).group(1))
+                for k in sub
+                if re.fullmatch(r"nconv_x2_(\d+)", k[0])
+            }
+        )
+        n_down = len(
+            {k[0] for k in sub if re.fullmatch(r"decoder_\d+", k[0])}
+        )
+        base = _torch_module_path(net)
+
+        def copy(src_seg: str, dst_dotted: str) -> None:
+            # nconv_x2_K untranslates to dotted 'nconv_x2.K'
+            src = f"{base}." + ".".join(_untranslate_segment(src_seg, False))
+            dst = f"{base}.{dst_dotted}"
+            for key in list(out):
+                if key.startswith(src + "."):
+                    out[dst + key[len(src):]] = out[key]
+
+        copy("nconv_in", "encoder.0.0")
+        for j in x2_idx:
+            copy(f"nconv_x2_{j}", f"encoder.0.1.{j}")
+        for stage in range(1, n_down + 1):
+            if any(k[0] == f"encoder_{stage}" for k in sub):
+                continue  # non-shared encoder: real params, already emitted
+            copy("nconv_x2_0", f"encoder.{stage}")
+
+
+def save_torch_checkpoint(
+    path: str, variables: dict, data_parallel: bool = True
+) -> None:
+    """``torch.save`` the exported state dict; ``data_parallel`` adds the
+    ``module.`` prefix the reference's eval-time strict load expects
+    (reference: evaluate.py:246-257)."""
+    import torch
+
+    state = {
+        (f"module.{k}" if data_parallel else k): torch.from_numpy(
+            np.ascontiguousarray(v)
+        )
+        for k, v in export_torch_state(variables).items()
+    }
+    torch.save(state, path)

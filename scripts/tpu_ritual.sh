@@ -40,14 +40,17 @@ r = probe_backend(timeout_s=float(os.environ.get("RITUAL_PROBE_TIMEOUT", "120"))
 print(f"{time.time()-t0:.0f}s|{r.platform or '-'}|{r.reason}")
 EOF
 )
-DUR=$(echo "$PROBE_OUT" | cut -d'|' -f1)
-PLATFORM=$(echo "$PROBE_OUT" | cut -d'|' -f2)
-REASON=$(echo "$PROBE_OUT" | cut -d'|' -f3)
-if [ -z "$DUR" ] || [ -z "$PLATFORM" ] || [ -z "$REASON" ]; then
-    echo "ritual: probe script failed (output: '$PROBE_OUT')" >&2
-    echo "| $TS | - | - | probe-script-error | none |" >> "$LOGFILE"
-    exit 1
-fi
+case "$PROBE_OUT" in
+    *'|'*'|'*) : ;;  # well-formed dur|platform|reason
+    *)
+        echo "ritual: probe script failed (output: '$PROBE_OUT')" >&2
+        echo "| $TS | - | - | probe-script-error | none |" >> "$LOGFILE"
+        exit 1
+        ;;
+esac
+DUR=$(echo "$PROBE_OUT" | cut -s -d'|' -f1)
+PLATFORM=$(echo "$PROBE_OUT" | cut -s -d'|' -f2)
+REASON=$(echo "$PROBE_OUT" | cut -s -d'|' -f3)
 echo "probe: platform=$PLATFORM reason=$REASON after $DUR"
 
 if [ "$REASON" = "ok" ] && [ "$PLATFORM" != "cpu" ] && [ "$PLATFORM" != "-" ]; then
@@ -69,17 +72,19 @@ if [ "$REASON" = "ok" ] && [ "$PLATFORM" != "cpu" ] && [ "$PLATFORM" != "-" ]; t
         | tee "$EVDIR/flips_$STAMP.txt"; then
         FOLLOWUP="${FOLLOWUP}bench row recorded (docs/perf_baseline.json, $EVDIR/)"
     fi
+    # The evidence block lives in its own committed file so the audit
+    # TABLE stays contiguous (markdown tables end at the first non-table
+    # line; an inline block would orphan every later row).
     {
-        echo "| $TS | $DUR | $PLATFORM | live | $FOLLOWUP |"
-        echo
-        echo "Evidence $TS:"
+        echo "# Evidence $TS"
         echo
         echo '```'
         tail -1 "$EVDIR/bench_$STAMP.out"
         cat "$EVDIR/flips_$STAMP.txt" 2>/dev/null
         echo '```'
-    } >> "$LOGFILE"
-    echo "== evidence banked in $EVDIR/ and $LOGFILE; commit these files."
+    } > "$EVDIR/evidence_$STAMP.md"
+    echo "| $TS | $DUR | $PLATFORM | live | $FOLLOWUP — $EVDIR/evidence_$STAMP.md |" >> "$LOGFILE"
+    echo "== evidence banked in $EVDIR/ (row appended to $LOGFILE); commit these files."
 else
     echo "| $TS | $DUR | $PLATFORM | $REASON | none (no accelerator) |" >> "$LOGFILE"
     echo "== tunnel not available ($REASON); attempt logged in $LOGFILE"

@@ -1,0 +1,143 @@
+"""Reference-keyed checkpoint EXPORT (VERDICT r4 #5).
+
+The inverse of the import path: our variables serialize to the exact
+state dict the PyTorch reference's strict ``load_state_dict`` consumes
+(reference: evaluate.py:246-257 — DataParallel wrap, strict load), so a
+model trained in this framework drops into the reference unchanged.
+Validated three ways: exact key-set equality against the real reference
+models, a strict torch-side load + full-model forward parity on exported
+random weights, and a lossless import(export(v)) round trip.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REFERENCE = "/root/reference"
+pytestmark = [
+    pytest.mark.reference,
+    pytest.mark.skipif(
+        not os.path.isdir(os.path.join(REFERENCE, "core")),
+        reason="reference repo not mounted",
+    ),
+]
+
+if os.path.isdir(os.path.join(REFERENCE, "core")):
+    sys.path.insert(0, os.path.join(REFERENCE, "core"))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from raft_ncup_tpu.config import ModelConfig  # noqa: E402
+from raft_ncup_tpu.models import RAFT  # noqa: E402
+from raft_ncup_tpu.utils.torch_export import (  # noqa: E402
+    export_torch_state,
+    save_torch_checkpoint,
+)
+from raft_ncup_tpu.utils.torch_import import import_torch_state  # noqa: E402
+
+from test_torch_parity import (  # noqa: E402
+    base_args,
+    make_pair,
+    ncup_args,
+    run_reference,
+)
+
+
+def _ref_model(variant: str, small: bool = False, dataset: str = "sintel"):
+    if variant == "raft":
+        from raft import RAFT as TorchRAFT
+
+        return TorchRAFT(base_args(small=small))
+    from raft_nc_dbl import RAFT as TorchNCUP
+
+    return TorchNCUP(ncup_args(dataset=dataset))
+
+
+@pytest.mark.parametrize(
+    "variant,small,dataset",
+    [
+        ("raft", False, "chairs"),
+        ("raft", True, "chairs"),
+        ("raft_nc_dbl", False, "sintel"),
+        ("raft_nc_dbl", False, "kitti"),
+    ],
+)
+def test_export_key_set_matches_reference(variant, small, dataset):
+    """Every key the reference model's strict load expects, no extras —
+    including the regenerated aliases (num_batches_tracked, duplicate
+    downsample norms, shared-encoder aliases)."""
+    import torch
+
+    torch.manual_seed(0)
+    tmodel = _ref_model(variant, small, dataset)
+    want = set(tmodel.state_dict().keys())
+
+    ours = RAFT(ModelConfig(variant=variant, small=small, dataset=dataset))
+    variables = ours.init(jax.random.key(0), (1, 64, 96, 3))
+    got = set(export_torch_state(variables).keys())
+    # num_batches_tracked is a torch buffer with no flax counterpart;
+    # everything else must match exactly too.
+    assert got == want
+
+
+def test_strict_torch_load_and_forward_parity():
+    """The reference model strict-loads our exported random weights and
+    computes the same flow (the parity harness run in reverse)."""
+    import torch
+
+    ours = RAFT(ModelConfig(variant="raft_nc_dbl", dataset="sintel"))
+    variables = ours.init(jax.random.key(5), (1, 128, 160, 3))
+    state = {
+        k: torch.from_numpy(np.ascontiguousarray(v))
+        for k, v in export_torch_state(variables).items()
+    }
+    tmodel = _ref_model("raft_nc_dbl")
+    tmodel.load_state_dict(state, strict=True)  # raises on any mismatch
+
+    img1, img2 = make_pair(3)
+    t_lr, t_up = run_reference(tmodel, img1, img2, iters=2)
+    j_lr, j_up = ours.apply(
+        variables, jnp.asarray(img1), jnp.asarray(img2), iters=2,
+        test_mode=True,
+    )
+    np.testing.assert_allclose(np.asarray(j_lr), t_lr, atol=2e-3, rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(j_up), t_up, atol=5e-3, rtol=1e-3)
+
+
+@pytest.mark.parametrize("variant", ["raft", "raft_nc_dbl"])
+def test_import_export_round_trip(variant):
+    """import(export(v)) == v bit-for-bit (float32 both ways)."""
+    ours = RAFT(ModelConfig(variant=variant, dataset="sintel"))
+    variables = ours.init(jax.random.key(2), (1, 64, 96, 3))
+    exported = export_torch_state(variables)
+    fresh = ours.init(jax.random.key(9), (1, 64, 96, 3))
+    back = import_torch_state(exported, fresh, strict=True)
+
+    flat_a = jax.tree_util.tree_leaves_with_path(variables)
+    flat_b = jax.tree_util.tree_leaves_with_path(back)
+    assert len(flat_a) == len(flat_b)
+    for (pa, va), (pb, vb) in zip(flat_a, flat_b):
+        assert pa == pb
+        np.testing.assert_array_equal(
+            np.asarray(va), np.asarray(vb), err_msg=str(pa)
+        )
+
+
+def test_save_torch_checkpoint_reference_eval_load(tmp_path):
+    """The saved .pth file loads into the reference exactly as its eval
+    driver does: torch.load + DataParallel-keyed strict load_state_dict
+    (reference: evaluate.py:246-257)."""
+    import torch
+
+    ours = RAFT(ModelConfig(variant="raft_nc_dbl", dataset="kitti"))
+    variables = ours.init(jax.random.key(4), (1, 64, 96, 3))
+    path = str(tmp_path / "ours_export.pth")
+    save_torch_checkpoint(path, variables, data_parallel=True)
+
+    tmodel = torch.nn.DataParallel(_ref_model("raft_nc_dbl", dataset="kitti"))
+    loaded = torch.load(path, map_location="cpu", weights_only=True)
+    tmodel.load_state_dict(loaded, strict=True)
