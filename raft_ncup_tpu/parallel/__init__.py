@@ -4,9 +4,11 @@ from raft_ncup_tpu.parallel.mesh import (  # noqa: F401
     replicated,
 )
 from raft_ncup_tpu.parallel.multihost import (  # noqa: F401
+    allreduce_sum_across_hosts,
     barrier,
     global_batch,
     initialize_distributed,
+    is_main_process,
     is_multihost,
 )
 from raft_ncup_tpu.parallel.step import (  # noqa: F401

@@ -75,6 +75,31 @@ def is_multihost() -> bool:
     return jax.process_count() > 1
 
 
+def is_main_process() -> bool:
+    """True on exactly one process per job — the only one that should
+    write human-facing output (log files, TensorBoard, submissions).
+    Orbax checkpoint saves stay all-process (orbax coordinates its own
+    per-host shard writes)."""
+    return jax.process_index() == 0
+
+
+def allreduce_sum_across_hosts(x) -> np.ndarray:
+    """Sum a host-local numpy accumulator over all processes.
+
+    The multi-host reduction for host-sharded validation: each process
+    validates its slice of the frames and the fixed-size metric
+    accumulator (sums and counts, NOT means) is summed across hosts so
+    every process returns identical global metrics. Single-process: a
+    cheap pass-through. Requires the same accumulator shape on every
+    process (``process_allgather`` stages one collective)."""
+    x = np.asarray(x)
+    if not is_multihost():
+        return x
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x)).sum(axis=0)
+
+
 def barrier(name: str, timeout_s: float = 480.0) -> bool:
     """Block until every process reaches this barrier (coordination
     service — no device collectives involved, so it tolerates arbitrary

@@ -22,12 +22,22 @@ class Logger:
         config: Any = None,
         sum_freq: int = 100,
         use_tensorboard: bool = True,
+        active: bool = True,
     ):
+        """``active=False`` makes every output a no-op — the non-main
+        processes of a pod, which would otherwise interleave N copies of
+        log.txt/TensorBoard into the same shared run_dir (the reference
+        is single-process and never faces this — train.py:102-164)."""
         self.run_dir = run_dir
         self.sum_freq = sum_freq
+        self.active = active
+        self._txt = None
+        self._writer = None
+        if not active:
+            self._pending = []
+            return
         os.makedirs(run_dir, exist_ok=True)
         self._txt = open(os.path.join(run_dir, "log.txt"), "a")
-        self._writer = None
         if use_tensorboard:
             try:
                 from torch.utils.tensorboard import SummaryWriter
@@ -56,12 +66,16 @@ class Logger:
             return repr(config)
 
     def write_text(self, text: str) -> None:
+        if not self.active:
+            return
         self._txt.write(text + "\n")
         self._txt.flush()
 
     def push(self, step: int, metrics: Mapping[str, Any], lr: Optional[float] = None) -> None:
         """Accumulate one step's metrics; emit a summary every sum_freq
         steps (reference: train.py:124-139)."""
+        if not self.active:
+            return
         self._pending.append(metrics)
         if self._steps_last is None:
             self._steps_last = step  # first push after start/resume
@@ -93,6 +107,8 @@ class Logger:
 
     def write_dict(self, step: int, results: Mapping[str, float]) -> None:
         """Log a validation-results dict (reference: train.py:151-161)."""
+        if not self.active:
+            return
         line = f"[val @ {step}] " + json.dumps(
             {k: round(float(v), 5) for k, v in results.items()}
         )
@@ -103,6 +119,7 @@ class Logger:
                 self._writer.add_scalar(f"val/{k}", float(v), step)
 
     def close(self) -> None:
-        self._txt.close()
+        if self._txt is not None:
+            self._txt.close()
         if self._writer is not None:
             self._writer.close()

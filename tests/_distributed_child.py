@@ -100,6 +100,42 @@ def main() -> None:
     assert np.isfinite(loss)
     print(f"LOSS={loss:.6f}", flush=True)
 
+    # --- multi-host output hygiene (VERDICT r4 #4) ---------------------
+    # Host-sharded validation: each process computes its slice of the
+    # held-out frames, the metric sums all-reduce, and both processes
+    # must report the SAME global EPE. The validator's console line must
+    # come from the main process only.
+    import json
+
+    from raft_ncup_tpu.data.synthetic import SyntheticFlowDataset
+    from raft_ncup_tpu.evaluation import _shard_for_validation, validate_synthetic
+    from raft_ncup_tpu.parallel.multihost import is_main_process
+
+    shard, n_agreed, do_reduce = _shard_for_validation(
+        SyntheticFlowDataset((32, 48), length=6, seed=999), mesh=None
+    )
+    assert (len(shard), n_agreed, do_reduce) == (3, 6, True)  # 6 over 2 hosts
+
+    variables = {"params": jax.tree.map(np.asarray, state.params)}
+    barrier("pre-validate")  # realign before the collective reduction
+    out = validate_synthetic(
+        model, variables, iters=1, batch_size=2, size_hw=(32, 48), length=6
+    )
+    print(f"VAL={json.dumps(out, sort_keys=True)}", flush=True)
+
+    # Logger hygiene: both processes construct a Logger on the same
+    # shared run_dir; only the main process may create/write log.txt.
+    from raft_ncup_tpu.training.logger import Logger
+
+    run_dir = sys.argv[3]
+    logger = Logger(
+        run_dir, sum_freq=1, use_tensorboard=False,
+        active=is_main_process(),
+    )
+    logger.write_text(f"hello from process {pid}")
+    logger.close()
+    print(f"LOGACTIVE={int(logger.active)}", flush=True)
+
 
 if __name__ == "__main__":
     main()

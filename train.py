@@ -32,6 +32,7 @@ def main(argv=None) -> None:
     from raft_ncup_tpu.parallel.multihost import (
         global_batch,
         initialize_distributed,
+        is_main_process,
         is_multihost,
     )
     from raft_ncup_tpu.parallel.step import make_train_step
@@ -48,7 +49,15 @@ def main(argv=None) -> None:
     np.random.seed(train_cfg.seed)  # reference: train.py:345-346
 
     run_dir = os.path.join(train_cfg.checkpoint_dir, train_cfg.name)
-    logger = Logger(run_dir, config=train_cfg, sum_freq=train_cfg.sum_freq)
+    # One writer per pod: only process 0 owns log.txt/TensorBoard (orbax
+    # saves stay all-process — it coordinates its own shard writes).
+    # Validation itself still runs on EVERY process: the validators
+    # host-shard the frames and all-reduce the metric sums, so each
+    # process computes its slice and returns identical global numbers.
+    logger = Logger(
+        run_dir, config=train_cfg, sum_freq=train_cfg.sum_freq,
+        active=is_main_process(),
+    )
 
     # Device mesh: data-parallel over all chips unless told otherwise. The
     # per-step global batch must divide evenly over the data axis; when the
@@ -152,6 +161,14 @@ def main(argv=None) -> None:
         variables = {"params": state.params}
         if state.batch_stats:
             variables["batch_stats"] = state.batch_stats
+        if multihost:
+            # The validators host-shard the frames (mesh=None path), so
+            # each host runs DIFFERENT host-local forwards. Pod-global
+            # jax.Arrays must not flow in: computation-follows-data would
+            # put those divergent programs on the global device
+            # assignment and desynchronize the pod. Pull params to host
+            # numpy so every forward is process-local.
+            variables = jax.tree.map(np.asarray, variables)
         for val_set in train_cfg.validation:
             results = VALIDATORS[val_set](model, variables, data_cfg)
             logger.write_dict(step, results)
