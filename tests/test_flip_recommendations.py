@@ -1,0 +1,95 @@
+"""Kernel-default recommendation logic (scripts/flip_recommendations.py).
+
+The ritual's last stage turns a bench record into flip/keep verdicts for
+``corr_impl`` and ``RAFT_NCUP_NCONV_IMPL``; these pin the decision rules
+so the one short live-chip window cannot hit a regressed recommender.
+"""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "flip_recommendations",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "flip_recommendations.py",
+    ),
+)
+flip = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(flip)
+
+
+def _tpu(**kw):
+    rec = {"value": 100.0, "baseline_key": "tpu@v5e:volume:2x368x768x12"}
+    rec.update(kw)
+    return rec
+
+
+class TestRecommend:
+    def test_cpu_record_never_flips(self):
+        lines = flip.recommend(
+            {"value": 9.0, "baseline_key": "cpu@host:volume:1x96x128x4",
+             "pairs_per_sec_onthefly": 20.0}
+        )
+        assert len(lines) == 1 and "defaults stay" in lines[0]
+
+    def test_corr_flip_requires_margin(self):
+        # 2% win: below the 3% margin -> keep.
+        lines = flip.recommend(_tpu(pairs_per_sec_onthefly=102.0))
+        assert any("keep 'volume'" in l for l in lines)
+        lines = flip.recommend(_tpu(pairs_per_sec_pallas=110.0))
+        assert any("FLIP default 'volume' -> 'pallas'" in l for l in lines)
+
+    def test_partial_nconv_fusion_blocks_flip(self):
+        lines = flip.recommend(
+            _tpu(pairs_per_sec_nconv_pallas=150.0, nconv_pallas_calls="2/12")
+        )
+        joined = "\n".join(lines)
+        assert "PARTIALLY fused" in joined and "do NOT flip" in joined
+        assert "FLIP default 'xla'" not in joined
+
+    def test_full_nconv_fusion_flips_on_win(self):
+        lines = flip.recommend(
+            _tpu(pairs_per_sec_nconv_pallas=150.0, nconv_pallas_calls="12/12")
+        )
+        assert any("FLIP default 'xla' -> 'pallas'" in l for l in lines)
+
+    def test_fell_back_row_keeps_xla(self):
+        lines = flip.recommend(
+            _tpu(pairs_per_sec_nconv_pallas_FELL_BACK_TO_XLA=150.0)
+        )
+        assert any("fell back to XLA" in l for l in lines)
+
+    def test_corr_partial_levels_annotated(self):
+        lines = flip.recommend(
+            _tpu(pairs_per_sec_pallas=180.0, corr_pallas_levels="2/4")
+        )
+        assert any("2/4 pyramid levels" in l for l in lines)
+
+
+class TestMain:
+    def _run(self, capsys, monkeypatch, text):
+        import io
+
+        monkeypatch.setattr(sys, "argv", ["flip_recommendations"])
+        monkeypatch.setattr(sys, "stdin", io.StringIO(text))
+        flip.main()
+        return capsys.readouterr().out
+
+    def test_accepts_bench_stdout_tail(self, capsys, monkeypatch):
+        out = self._run(
+            capsys, monkeypatch,
+            'noise line\n{"value": 9.0, "baseline_key": "cpu@h:volume:x"}\n',
+        )
+        assert "defaults stay" in out
+
+    def test_empty_input_fails_loudly(self, capsys, monkeypatch):
+        with pytest.raises(SystemExit):
+            self._run(capsys, monkeypatch, "")
+
+    def test_non_json_input_fails_loudly(self, capsys, monkeypatch):
+        with pytest.raises(SystemExit):
+            self._run(capsys, monkeypatch, "not json at all")
