@@ -8,8 +8,11 @@ inference at 12 GRU iterations, 368x768 (the Sintel fine-tune crop,
 reference: train_raft_nc_sintel.sh:14). Extra fields: ``flops_per_pair``
 and ``mfu`` (XLA cost-analysis FLOPs over the chip's peak — see
 raft_ncup_tpu/utils/flops.py) and, budget permitting, a train-step
-measurement (``train_pairs_per_sec``) since the north-star target is
-training wall-clock (BASELINE.json).
+measurement (``train_pairs_per_sec``) plus a PIPELINED train-loop
+measurement (``train_loop_pairs_per_sec``: N steps through the async
+input pipeline with one end-of-window sync — separates compute from
+input/sync stall) since the north-star target is training wall-clock
+(BASELINE.json).
 
 Robustness (round-2 postmortem, VERDICT.md "What's weak" #1): the axon TPU
 backend can HANG inside ``jax.devices()`` rather than fail fast, and the
@@ -253,25 +256,54 @@ def _child_main() -> None:
 
     # Train-step measurement (north star is training wall-clock) — only if
     # at least ~45% of the child budget remains. BENCH_SKIP_TRAIN=1 turns
-    # it off explicitly (the full-shape CPU anchor: a fwd+bwd at 368x768
-    # on a 1-core host would run for tens of minutes).
+    # off BOTH train rows — the isolated step and the pipelined loop —
+    # explicitly (the full-shape CPU anchor: a fwd+bwd at 368x768 on a
+    # 1-core host would run for tens of minutes).
     remaining = child_budget - (time.monotonic() - t0)
     if os.environ.get("BENCH_SKIP_TRAIN") == "1":
         pass
     elif remaining > 0.45 * child_budget:
+        handles = None
         try:
-            train = _measure_train_step(shape, mixed_precision, corr_impl)
+            train, handles = _measure_train_step(
+                shape, mixed_precision, corr_impl
+            )
             record.update(train)
             _emit(record)
         except Exception as e:  # never lose the inference record
             print(f"train-step bench failed: {e}", file=sys.stderr)
+        # Pipelined-loop row: N steps through the async input pipeline
+        # (device prefetch + device-accumulated metrics, one sync at the
+        # end) vs the per-step-synced row above. The delta is the
+        # input/sync stall the pipeline does (or does not) hide — see
+        # docs/PERF.md for how the stall fraction is derived.
+        if (
+            handles is not None
+            and child_budget - (time.monotonic() - t0) > 0.2 * child_budget
+        ):
+            try:
+                loop = _measure_train_loop(handles)
+                if "train_ms_per_step" in record:
+                    loop["train_loop_stall_ms_per_step"] = round(
+                        loop["train_loop_ms_per_step"]
+                        - record["train_ms_per_step"],
+                        1,
+                    )
+                record.update(loop)
+                _emit(record)
+            except Exception as e:  # never lose the per-step record
+                print(f"train-loop bench failed: {e}", file=sys.stderr)
 
 
 def _measure_train_step(
     shape: dict, mixed_precision: bool, corr_impl: str
-) -> dict:
+) -> tuple[dict, dict]:
     """Time one optimizer step (fwd+bwd+update) at the bench shape,
-    reference workload anchor: train.py:201-225."""
+    reference workload anchor: train.py:201-225.
+
+    Returns ``(record_fields, handles)`` — handles carry the compiled step
+    and the carried state so the pipelined-loop row reuses the same
+    executable (no second multi-minute compile on the CPU host)."""
     import jax
     import numpy as np
 
@@ -308,10 +340,75 @@ def _measure_train_step(
         one_step, warmup=2, reps=3,
         sync=lambda m: np.asarray(m["loss"]),
     )
-    return {
+    fields = {
         "train_pairs_per_sec": round(B * rate, 4),
         "train_ms_per_step": round(1000.0 / rate, 1),
         "train_rep_ms": [round(t * 1e3, 1) for t in rep_times],
+    }
+    handles = {
+        "step": step, "state": holder["state"], "krng": krng,
+        "B": B, "H": H, "W": W,
+    }
+    return fields, handles
+
+
+def _measure_train_loop(handles: dict, steps: int | None = None) -> dict:
+    """Wall-clock N PIPELINED steps — the steady-state train.py loop.
+
+    Host batches flow through the DevicePrefetcher (transfer overlapped
+    with compute), the per-step loss accumulates ON DEVICE (the Logger
+    contract: no float()/device_get between summary boundaries), and the
+    host syncs ONCE at the end of the window. ``train_ms_per_step`` above
+    measures the same compiled step with a per-step sync on a pre-placed
+    batch, so ``train_loop_ms_per_step - train_ms_per_step`` is the
+    input + sync stall the async pipeline failed to hide; <= 0 means the
+    overlap is complete and the dispatch-pipelined loop beats the
+    serialized one.
+    """
+    import jax  # noqa: F401 — device transfers happen in the prefetcher
+    import numpy as np
+
+    from raft_ncup_tpu.data.device_prefetch import DevicePrefetcher
+
+    step, krng = handles["step"], handles["krng"]
+    B, H, W = handles["B"], handles["H"], handles["W"]
+    steps = steps or int(os.environ.get("BENCH_TRAIN_LOOP_STEPS", "6"))
+
+    rng = np.random.default_rng(11)
+
+    def host_batches(n: int):
+        # Fresh host arrays every step so the prefetcher really transfers
+        # per step. float32 images to match make_synthetic_batch's avals —
+        # uint8 would change the jit signature and recompile the step,
+        # which on the 1-core CPU host costs minutes.
+        for _ in range(n):
+            yield {
+                "image1": (rng.random((B, H, W, 3), np.float32) * 255.0),
+                "image2": (rng.random((B, H, W, 3), np.float32) * 255.0),
+                "flow": rng.standard_normal((B, H, W, 2)).astype(np.float32),
+                "valid": np.ones((B, H, W), np.float32),
+            }
+
+    holder = {"state": handles["state"]}
+    with DevicePrefetcher(host_batches(steps + 1), depth=2) as pf:
+        # One warmup step: fills the pipeline and proves the executable is
+        # reused (same avals as the per-step row — no recompile).
+        holder["state"], m = step(holder["state"], next(pf), krng)
+        np.asarray(m["loss"])
+        loss_acc = None
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            holder["state"], metrics = step(holder["state"], next(pf), krng)
+            loss_acc = (
+                metrics["loss"] if loss_acc is None
+                else loss_acc + metrics["loss"]
+            )
+        np.asarray(loss_acc)  # the window's single host sync
+        dt = time.perf_counter() - t0
+    return {
+        "train_loop_pairs_per_sec": round(B * steps / dt, 4),
+        "train_loop_ms_per_step": round(dt * 1000.0 / steps, 1),
+        "train_loop_steps": steps,
     }
 
 
@@ -414,6 +511,10 @@ def main() -> None:
                     if r2.get("train_pairs_per_sec") is not None:
                         result[f"train_pairs_per_sec_{tag}"] = r2[
                             "train_pairs_per_sec"
+                        ]
+                    if r2.get("train_loop_pairs_per_sec") is not None:
+                        result[f"train_loop_pairs_per_sec_{tag}"] = r2[
+                            "train_loop_pairs_per_sec"
                         ]
                     # Partial-fusion annotations must ride along: a row
                     # whose kernel only fused at some call sites/levels is

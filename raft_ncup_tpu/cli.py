@@ -133,6 +133,9 @@ def add_data_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--chairs_split_file", default=d.chairs_split_file)
     parser.add_argument("--compressed_ft", action="store_true")
     parser.add_argument("--num_workers", type=int, default=4)
+    parser.add_argument("--device_prefetch", type=int, default=d.device_prefetch,
+                        help="device-side prefetch depth: batches staged on "
+                        "device ahead of compute (>=2 hides the transfer)")
     parser.add_argument("--synthetic_ok", action="store_true",
                         help="fall back to procedural data if roots missing")
     parser.add_argument("--synthetic_style", default=d.synthetic_style,
@@ -261,6 +264,7 @@ def data_config_from_args(args: argparse.Namespace) -> DataConfig:
         chairs_split_file=args.chairs_split_file,
         compressed_ft=args.compressed_ft,
         num_workers=args.num_workers,
+        device_prefetch=args.device_prefetch,
         synthetic_ok=args.synthetic_ok,
         synthetic_style=args.synthetic_style,
     )

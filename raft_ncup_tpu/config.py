@@ -181,6 +181,11 @@ class DataConfig:
     compressed_ft: bool = False
     num_workers: int = 2
     prefetch: int = 2
+    # Device-side prefetch depth: host batches are moved to device this
+    # many steps ahead of compute (DevicePrefetcher). >= 2 keeps one batch
+    # in flight while the next transfers, so the accelerator never waits
+    # on host→device transfer in steady state.
+    device_prefetch: int = 2
     # When no dataset is present on disk, the loader can serve procedurally
     # generated pairs so training/benchmarking still exercises the full path.
     synthetic_ok: bool = False

@@ -14,6 +14,7 @@ from raft_ncup_tpu.data.datasets import (
     MpiSintel,
     fetch_training_set,
 )
+from raft_ncup_tpu.data.device_prefetch import DevicePrefetcher
 from raft_ncup_tpu.data.loader import FlowLoader
 from raft_ncup_tpu.data.synthetic import SyntheticFlowDataset
 
@@ -30,6 +31,7 @@ __all__ = [
     "HD1K",
     "MixedDataset",
     "fetch_training_set",
+    "DevicePrefetcher",
     "FlowLoader",
     "SyntheticFlowDataset",
 ]

@@ -483,7 +483,13 @@ def validate_synthetic(
     prefix = "synthetic" if style == "smooth" else f"synthetic_{style}"
     dataset = SyntheticFlowDataset(size_hw, length=length, seed=999,
                                    style=style)
-    dataset, _, do_reduce = _shard_for_validation(dataset, mesh)
+    dataset, n, do_reduce = _shard_for_validation(dataset, mesh)
+    if n == 0:
+        # Mirror the real-data validators: an empty agreed length (e.g.
+        # length=0, or more hosts than frames) must skip, not divide by
+        # zero below (ADVICE r5).
+        _print_main("validate_synthetic: no frames after sharding, skipping")
+        return {}
     fwd = _ShapeCachedForward(model, variables, mesh=mesh)
     # [epe_sum, n, bnd_sum, n_bnd, interior_sum, n_interior]
     acc = np.zeros(6)

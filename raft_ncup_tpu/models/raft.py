@@ -22,6 +22,14 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
+# jax.shard_map was promoted out of jax.experimental after 0.4.x; resolve
+# whichever this jax ships so the spatially-sharded corr lookup works on
+# both (the call sites use the keyword form, identical in both APIs).
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from raft_ncup_tpu.config import ModelConfig
 from raft_ncup_tpu.nn.extractor import Encoder
 from raft_ncup_tpu.nn.update import BasicUpdateBlock, SmallUpdateBlock
@@ -246,7 +254,7 @@ class RAFT:
                             f1_loc, f2_full, c_loc, radius, cfg.corr_levels
                         )
 
-                    return jax.shard_map(
+                    return _shard_map(
                         local,
                         mesh=mesh,
                         in_specs=(

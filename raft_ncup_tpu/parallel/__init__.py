@@ -6,6 +6,7 @@ from raft_ncup_tpu.parallel.mesh import (  # noqa: F401
 from raft_ncup_tpu.parallel.multihost import (  # noqa: F401
     allreduce_sum_across_hosts,
     barrier,
+    device_put_batch,
     global_batch,
     initialize_distributed,
     is_main_process,

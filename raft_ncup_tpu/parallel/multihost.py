@@ -71,6 +71,28 @@ def global_batch(batch: dict, mesh: Mesh, shardings: dict) -> dict:
     return out
 
 
+def device_put_batch(
+    batch: dict, mesh: Optional[Mesh], shardings: Optional[dict]
+) -> dict:
+    """Move one host-local batch dict onto device — the single transfer
+    policy shared by the train loop's async prefetcher and the bench's
+    pipelined-loop row.
+
+    Multi-host with a mesh: each host contributes its local shard and the
+    result is a dict of global ``jax.Array`` (:func:`global_batch`).
+    Single-process with shardings: ``jax.device_put`` straight into the
+    batch sharding's layout, so the jitted step's dispatch does no
+    re-layout. No shardings: default device placement.
+    """
+    if mesh is not None and shardings is not None and is_multihost():
+        return global_batch(batch, mesh, shardings)
+    shardings = shardings or {}
+    return {
+        key: jax.device_put(np.asarray(value), shardings.get(key))
+        for key, value in batch.items()
+    }
+
+
 def is_multihost() -> bool:
     return jax.process_count() > 1
 

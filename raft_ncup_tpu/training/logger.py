@@ -14,6 +14,8 @@ import os
 import time
 from typing import Any, Mapping, Optional
 
+import jax
+
 
 class Logger:
     def __init__(
@@ -33,8 +35,15 @@ class Logger:
         self.active = active
         self._txt = None
         self._writer = None
+        # Metrics accumulate as running sums ON DEVICE (device scalars stay
+        # device scalars; `+` dispatches asynchronously) and are pulled to
+        # host with ONE jax.device_get only when a summary fires. A per-push
+        # float(v) would be a per-step block_until_ready — it collapses
+        # JAX's async dispatch and puts a host round-trip on the critical
+        # path of every training step.
+        self._acc: dict[str, Any] = {}
+        self._acc_n = 0
         if not active:
-            self._pending = []
             return
         os.makedirs(run_dir, exist_ok=True)
         self._txt = open(os.path.join(run_dir, "log.txt"), "a")
@@ -47,10 +56,6 @@ class Logger:
                 )
             except ImportError:
                 pass
-        # Metrics accumulate as-is (possibly device scalars) and are only
-        # converted to host floats when a summary fires, so pushing never
-        # forces a device sync mid-step.
-        self._pending: list[Mapping[str, Any]] = []
         self._t_last = time.perf_counter()
         self._steps_last: Optional[int] = None
         if config is not None:
@@ -73,19 +78,25 @@ class Logger:
 
     def push(self, step: int, metrics: Mapping[str, Any], lr: Optional[float] = None) -> None:
         """Accumulate one step's metrics; emit a summary every sum_freq
-        steps (reference: train.py:124-139)."""
+        steps (reference: train.py:124-139).
+
+        Between summaries this performs ZERO host transfers: device
+        scalars are summed on device (async dispatch), and the single
+        ``jax.device_get`` at the boundary is the only synchronization
+        point the logger ever introduces."""
         if not self.active:
             return
-        self._pending.append(metrics)
+        for k, v in metrics.items():
+            prev = self._acc.get(k)
+            self._acc[k] = v if prev is None else prev + v
+        self._acc_n += 1
         if self._steps_last is None:
             self._steps_last = step  # first push after start/resume
-        if (step + 1) % self.sum_freq == 0 and self._pending:
+        if (step + 1) % self.sum_freq == 0 and self._acc_n:
             lr = None if lr is None else float(lr)
-            sums: dict[str, float] = {}
-            for m in self._pending:
-                for k, v in m.items():
-                    sums[k] = sums.get(k, 0.0) + float(v)
-            means = {k: v / len(self._pending) for k, v in sums.items()}
+            sums = jax.device_get(self._acc)  # one transfer for the dict
+            means = {k: float(v) / self._acc_n for k, v in sums.items()}
+            self._acc, self._acc_n = {}, 0
             now = time.perf_counter()
             sps = (step + 1 - self._steps_last) / max(now - self._t_last, 1e-9)
             self._t_last, self._steps_last = now, step + 1
@@ -103,7 +114,6 @@ class Logger:
                 if lr is not None:
                     self._writer.add_scalar("train/lr", lr, step + 1)
                 self._writer.add_scalar("train/steps_per_sec", sps, step + 1)
-            self._pending = []
 
     def write_dict(self, step: int, results: Mapping[str, float]) -> None:
         """Log a validation-results dict (reference: train.py:151-161)."""

@@ -39,28 +39,40 @@ def recommend(record: dict) -> list[str]:
         if v:
             corr[tag] = v
     corr = {k: v for k, v in corr.items() if v}
-    best = max(corr, key=corr.get)
-    if len(corr) < 2:
+    if not corr or "volume" not in corr:
+        # Without the volume row there is no corr comparison: a
+        # watchdog-killed primary attempt can leave only variant rows (or
+        # none), and flipping on variant-vs-variant data would change the
+        # default with no baseline evidence (ADVICE r5). The nconv section
+        # below still runs — its fell-back diagnosis needs no baseline.
         lines.append(
-            f"corr_impl: only {list(corr)} measured; no comparison possible"
-        )
-    elif best != "volume" and corr[best] >= MARGIN * corr.get("volume", 0):
-        lines.append(
-            f"corr_impl: FLIP default 'volume' -> '{best}' "
-            f"({corr[best]:.2f} vs {corr['volume']:.2f} pairs/s; "
-            "edit raft_ncup_tpu/config.py ModelConfig.corr_impl)"
+            "corr_impl: no volume baseline in record "
+            f"(measured: {sorted(corr) or 'none'}); defaults stay — "
+            "rerun bench for the primary row"
         )
     else:
-        lines.append(
-            f"corr_impl: keep 'volume' ({ {k: round(v, 2) for k, v in corr.items()} })"
-        )
+        best = max(corr, key=corr.get)
+        if len(corr) < 2:
+            lines.append(
+                f"corr_impl: only {list(corr)} measured; no comparison possible"
+            )
+        elif best != "volume" and corr[best] >= MARGIN * corr.get("volume", 0):
+            lines.append(
+                f"corr_impl: FLIP default 'volume' -> '{best}' "
+                f"({corr[best]:.2f} vs {corr['volume']:.2f} pairs/s; "
+                "edit raft_ncup_tpu/config.py ModelConfig.corr_impl)"
+            )
+        else:
+            lines.append(
+                f"corr_impl: keep 'volume' ({ {k: round(v, 2) for k, v in corr.items()} })"
+            )
 
-    if "corr_pallas_levels" in record and "pallas" in corr:
-        lines.append(
-            f"corr: note — pallas row ran the kernel on "
-            f"{record['corr_pallas_levels']} pyramid levels (per-level "
-            "VMEM gating; partial dispatch is by design at large shapes)"
-        )
+        if "corr_pallas_levels" in record and "pallas" in corr:
+            lines.append(
+                f"corr: note — pallas row ran the kernel on "
+                f"{record['corr_pallas_levels']} pyramid levels (per-level "
+                "VMEM gating; partial dispatch is by design at large shapes)"
+            )
 
     nc = record.get("pairs_per_sec_nconv_pallas")
     fell_back = record.get("pairs_per_sec_nconv_pallas_FELL_BACK_TO_XLA")
@@ -89,6 +101,11 @@ def recommend(record: dict) -> list[str]:
             lines.append(
                 f"nconv: keep 'xla' (pallas {nc:.2f} vs xla {base:.2f} pairs/s)"
             )
+    elif nc:
+        lines.append(
+            f"nconv: pallas row measured ({nc:.2f} pairs/s) but no volume "
+            "baseline to compare against; keep 'xla'"
+        )
     elif fell_back:
         lines.append(
             "nconv: pallas row fell back to XLA at this shape "

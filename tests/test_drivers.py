@@ -376,6 +376,17 @@ def test_validate_synthetic_heldout():
     assert np.isfinite(out["synthetic"])
 
 
+def test_validate_synthetic_empty_shard_skips():
+    """Agreed length 0 (empty host shard) must skip like the real-data
+    validators, not divide by zero — the guard fires before any forward,
+    so model/variables are never touched."""
+    from raft_ncup_tpu.evaluation import validate_synthetic
+
+    out = validate_synthetic(None, {}, iters=2, batch_size=2,
+                             size_hw=(32, 48), length=0)
+    assert out == {}
+
+
 def test_validate_synthetic_spatial_mesh_matches():
     """The mesh-sharded eval path (evaluate.py --spatial_parallel) must
     reproduce the single-device validator EPE."""

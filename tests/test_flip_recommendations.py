@@ -69,6 +69,38 @@ class TestRecommend:
         )
         assert any("2/4 pyramid levels" in l for l in lines)
 
+    def test_missing_volume_row_skips_corr_comparison(self):
+        # A watchdog-killed primary attempt can leave variant rows only:
+        # no crash, no flip, an explicit "no volume baseline" verdict.
+        lines = flip.recommend(
+            _tpu(value=None, pairs_per_sec_onthefly=120.0)
+        )
+        joined = "\n".join(lines)
+        assert "no volume baseline in record" in joined
+        assert "FLIP" not in joined
+
+    def test_missing_volume_row_keeps_nconv_diagnosis(self):
+        # The nconv section is independent of the corr baseline: its
+        # fell-back note must survive a missing volume row, and a fused
+        # row without a baseline must be reported, not flipped.
+        lines = flip.recommend(
+            _tpu(value=None,
+                 pairs_per_sec_nconv_pallas_FELL_BACK_TO_XLA=150.0)
+        )
+        assert any("fell back to XLA" in l for l in lines)
+        lines = flip.recommend(
+            _tpu(value=None, pairs_per_sec_nconv_pallas=150.0,
+                 nconv_pallas_calls="12/12")
+        )
+        joined = "\n".join(lines)
+        assert "no volume baseline to compare" in joined
+        assert "FLIP" not in joined
+
+    def test_empty_corr_returns_early(self):
+        lines = flip.recommend({"baseline_key": "tpu@v5e:volume:x",
+                                "value": 0.0})
+        assert any("no volume baseline in record" in l for l in lines)
+
 
 class TestMain:
     def _run(self, capsys, monkeypatch, text):

@@ -63,6 +63,42 @@ def test_guard_forces_cpu_when_backend_hangs(monkeypatch):
     assert jax.config.jax_platforms == "cpu"
 
 
+def test_backend_already_initialized_detection(monkeypatch):
+    import jax
+
+    jax.devices()  # ensure a backend exists in this process
+    assert ge._backend_already_initialized() is True
+    # Unimportable/absent registry degrades to False (open fail).
+    import sys as _sys
+
+    monkeypatch.setitem(_sys.modules, "jax._src.xla_bridge", None)
+    assert ge._backend_already_initialized() is False
+
+
+def test_repoint_warns_instead_of_noop_when_backend_initialized(
+    monkeypatch, capsys
+):
+    """With a backend already initialized, jax.config.update silently
+    no-ops — the guard must say the re-point cannot apply rather than
+    claim success (ADVICE r5), and must leave the config untouched."""
+    import jax
+
+    jax.devices()  # the tests' CPU backend counts as prior init
+    assert jax.config.jax_platforms == "cpu"
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setattr(
+        backend_probe,
+        "probe_backend",
+        lambda *a, **k: backend_probe.ProbeResult("axon", "ok"),
+    )
+    assert ge.ensure_live_backend_for_caller() == "live"
+    err = capsys.readouterr().err
+    assert "cannot apply" in err and "restart" in err
+    # The config was NOT rewritten (the update would not apply anyway —
+    # and rewriting it would desync config from the live backend).
+    assert jax.config.jax_platforms == "cpu"
+
+
 def test_guard_probes_at_most_once(monkeypatch):
     monkeypatch.setenv("JAX_PLATFORMS", "axon")
     calls = []
