@@ -364,15 +364,30 @@ def _measure_train_loop(handles: dict, steps: int | None = None) -> dict:
     input + sync stall the async pipeline failed to hide; <= 0 means the
     overlap is complete and the dispatch-pipelined loop beats the
     serialized one.
+
+    The window runs under the runtime guards (analysis/guards.py), so the
+    record tracks the INVARIANT next to the speed:
+    ``train_loop_recompiles`` (XLA compiles inside the steady-state
+    window; 0 when avals are stable) and ``train_loop_host_transfers``
+    (implicit device→host pulls; 0 when the loop is sync-free — the
+    single end-of-window pull goes through the sanctioned explicit
+    ``jax.device_get``). Guards count by default; ``BENCH_STRICT_GUARDS=1``
+    makes a violation raise instead of recording a nonzero counter.
     """
-    import jax  # noqa: F401 — device transfers happen in the prefetcher
+    import jax
     import numpy as np
 
+    from raft_ncup_tpu.analysis.guards import (
+        GuardStats,
+        RecompileWatchdog,
+        forbid_host_transfers,
+    )
     from raft_ncup_tpu.data.device_prefetch import DevicePrefetcher
 
     step, krng = handles["step"], handles["krng"]
     B, H, W = handles["B"], handles["H"], handles["W"]
     steps = steps or int(os.environ.get("BENCH_TRAIN_LOOP_STEPS", "6"))
+    strict = os.environ.get("BENCH_STRICT_GUARDS") == "1"
 
     rng = np.random.default_rng(11)
 
@@ -390,25 +405,34 @@ def _measure_train_loop(handles: dict, steps: int | None = None) -> dict:
             }
 
     holder = {"state": handles["state"]}
+    stats = GuardStats()
     with DevicePrefetcher(host_batches(steps + 1), depth=2) as pf:
         # One warmup step: fills the pipeline and proves the executable is
         # reused (same avals as the per-step row — no recompile).
         holder["state"], m = step(holder["state"], next(pf), krng)
+        m["loss"] + m["loss"]  # pre-warm the accumulator's scalar add
         np.asarray(m["loss"])
-        loss_acc = None
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            holder["state"], metrics = step(holder["state"], next(pf), krng)
-            loss_acc = (
-                metrics["loss"] if loss_acc is None
-                else loss_acc + metrics["loss"]
-            )
-        np.asarray(loss_acc)  # the window's single host sync
-        dt = time.perf_counter() - t0
+        with RecompileWatchdog() as wd, forbid_host_transfers(
+            stats, raise_on_violation=strict
+        ):
+            loss_acc = None
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                holder["state"], metrics = step(
+                    holder["state"], next(pf), krng
+                )
+                loss_acc = (
+                    metrics["loss"] if loss_acc is None
+                    else loss_acc + metrics["loss"]
+                )
+            jax.device_get(loss_acc)  # the window's single SANCTIONED sync
+            dt = time.perf_counter() - t0
     return {
         "train_loop_pairs_per_sec": round(B * steps / dt, 4),
         "train_loop_ms_per_step": round(dt * 1000.0 / steps, 1),
         "train_loop_steps": steps,
+        "train_loop_recompiles": wd.count,
+        "train_loop_host_transfers": stats.host_transfers,
     }
 
 

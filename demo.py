@@ -77,7 +77,10 @@ def main(argv=None) -> None:
         padder = InputPadder(img1.shape)
         p1, p2 = padder.pad(jnp.asarray(img1), jnp.asarray(img2))
         _, flow_up = forward(variables, p1, p2)
-        flow = np.asarray(padder.unpad(flow_up)[0])
+        # unpad on device (pure slice), then ONE explicit pull per frame —
+        # np.asarray here would be an implicit d2h sync (JGL001's runtime
+        # analogue).
+        flow = jax.device_get(padder.unpad(flow_up)[0])
 
         vis = np.concatenate(
             [img1[0].astype(np.uint8), flow_to_image(flow)], axis=0

@@ -1,0 +1,8 @@
+"""``python -m raft_ncup_tpu.analysis`` — graftlint CLI entry point."""
+
+import sys
+
+from raft_ncup_tpu.analysis.lint import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

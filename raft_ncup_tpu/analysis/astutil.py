@@ -1,0 +1,362 @@
+"""Shared AST plumbing for graftlint (pure stdlib — no jax import).
+
+The analyses here are deliberately *syntactic*: graftlint runs in CI and
+pre-commit where importing jax (and initializing a backend) is both slow
+and, on a wedged accelerator tunnel, a hang risk (bench.py's probe exists
+for exactly that failure mode). Everything a rule needs — import aliases,
+dotted-name resolution, and the traced-region index — is derived from the
+AST alone.
+
+Traced-region detection is the load-bearing piece. A function is
+considered *traced* (its body executes under jax tracing, where host
+syncs, nondeterminism and Python control flow on tracers are bugs) when:
+
+1. it is decorated with a jax transform (``@jax.jit``, ``@partial(jax.jit,
+   ...)``, ``@jax.checkpoint``, ...);
+2. it is passed by name (or as a lambda) to a transform call —
+   ``jax.jit(f)``, ``jax.lax.scan(body, ...)``, ``shard_map(local, ...)``
+   — including through simple assignment chains
+   (``body = jax.checkpoint(step); jax.lax.scan(body, ...)``);
+3. it is defined inside a traced function; or
+4. it is called by name from a traced function in the same module
+   (transitive closure).
+
+This is a per-module approximation: calls that cross module boundaries
+through attributes (``model.apply``) are not followed. That boundary is
+documented in docs/ANALYSIS.md — the rules stay high-precision inside it
+and the allowlist absorbs the rest.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+_PARENT = "_graftlint_parent"
+
+FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# Fully-qualified callables whose function-valued arguments are traced.
+TRACE_WRAPPERS = frozenset(
+    {
+        "jax.jit",
+        "jax.pjit",
+        "jax.experimental.pjit.pjit",
+        "jax.vmap",
+        "jax.pmap",
+        "jax.grad",
+        "jax.value_and_grad",
+        "jax.jacfwd",
+        "jax.jacrev",
+        "jax.hessian",
+        "jax.checkpoint",
+        "jax.remat",
+        "jax.ad_checkpoint.checkpoint",
+        "jax.custom_jvp",
+        "jax.custom_vjp",
+        "jax.named_call",
+        "jax.shard_map",
+        "jax.experimental.shard_map.shard_map",
+        "jax.lax.scan",
+        "jax.lax.while_loop",
+        "jax.lax.fori_loop",
+        "jax.lax.cond",
+        "jax.lax.switch",
+        "jax.lax.map",
+        "jax.lax.associative_scan",
+        "jax.lax.custom_root",
+    }
+)
+
+# Last-segment fallbacks: catches local rebinds like the repo's
+# ``_shard_map = jax.shard_map`` compat alias, ``from jax import jit``,
+# and ``self.jit_fn``-style wrappers. Conservative in the traced
+# direction: a stray user function named ``scan`` marks its callees
+# traced, which at worst produces an allowlistable finding, never a miss.
+TRACE_WRAPPER_TAILS = frozenset(
+    {
+        "jit",
+        "pjit",
+        "vmap",
+        "pmap",
+        "grad",
+        "value_and_grad",
+        "checkpoint",
+        "remat",
+        "scan",
+        "while_loop",
+        "fori_loop",
+        "cond",
+        "switch",
+        "shard_map",
+    }
+)
+
+# Roots that can never be jax transforms even when a tail matches.
+_NON_JAX_ROOTS = frozenset(
+    {
+        "numpy",
+        "scipy",
+        "torch",
+        "tensorflow",
+        "tf",
+        "pandas",
+        "itertools",
+        "functools",
+        "os",
+        "re",
+        "cv2",
+    }
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint finding, addressable by the allowlist as
+    ``path::rule::qualname``."""
+
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    qualname: str = "<module>"
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"[{self.qualname}] {self.message}"
+        )
+
+
+def attach_parents(tree: ast.AST) -> None:
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            setattr(child, _PARENT, parent)
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, _PARENT, None)
+
+
+def enclosing_functions(node: ast.AST) -> Iterator[ast.AST]:
+    """All function nodes containing ``node``, innermost first."""
+    cur = parent(node)
+    while cur is not None:
+        if isinstance(cur, FUNC_NODES):
+            yield cur
+        cur = parent(cur)
+
+
+def qualname(node: ast.AST) -> str:
+    """Dotted enclosing-function path, e.g. ``make_train_step.step``;
+    ``<module>`` at top level."""
+    names = []
+    cur = node if isinstance(node, FUNC_NODES) else None
+    if cur is None:
+        for fn in enclosing_functions(node):
+            cur = fn
+            break
+    while cur is not None:
+        names.append(getattr(cur, "name", "<lambda>"))
+        cur = next(enclosing_functions(cur), None)
+    return ".".join(reversed(names)) if names else "<module>"
+
+
+def collect_aliases(tree: ast.AST) -> dict:
+    """Map local names to fully-qualified import paths.
+
+    ``import jax.numpy as jnp`` -> ``{'jnp': 'jax.numpy'}``;
+    ``from jax.sharding import PartitionSpec as P`` ->
+    ``{'P': 'jax.sharding.PartitionSpec'}``; plain ``import numpy``
+    binds the top-level name to itself.
+    """
+    aliases: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    aliases[a.asname] = a.name
+                else:
+                    top = a.name.split(".")[0]
+                    aliases[top] = top
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.level == 0:
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted_name(node: ast.AST, aliases: dict) -> Optional[str]:
+    """Resolve ``Name``/``Attribute`` chains to a dotted string with the
+    leading segment expanded through import aliases; None for anything
+    dynamic (subscripts, calls)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(aliases.get(node.id, node.id))
+    return ".".join(reversed(parts))
+
+
+def is_trace_wrapper(func_node: ast.AST, aliases: dict) -> bool:
+    dn = dotted_name(func_node, aliases)
+    if dn is None:
+        return False
+    if dn in TRACE_WRAPPERS:
+        return True
+    tail = dn.split(".")[-1].lstrip("_")
+    if tail not in TRACE_WRAPPER_TAILS:
+        return False
+    # Tail matches: accept unless rooted in a module known to be non-jax
+    # (``scipy.signal.cond`` stays out; ``self._jit``, ``_shard_map`` and
+    # jax-rooted paths are in — missing a wrapper silently un-traces a
+    # region, so the bias is toward marking).
+    root = dn.split(".")[0].lstrip("_")
+    return root not in _NON_JAX_ROOTS
+
+
+@dataclass
+class TracedIndex:
+    """Per-module index of function nodes whose bodies run under jax
+    tracing (see module docstring for the marking rules)."""
+
+    tree: ast.AST
+    aliases: dict
+    traced: set = field(default_factory=set)
+    _defs_by_name: dict = field(default_factory=dict)
+    _assigns: dict = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._defs_by_name.setdefault(node.name, []).append(node)
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self._assigns.setdefault(tgt.id, []).append(node.value)
+        self._seed()
+        self._propagate()
+
+    # ------------------------------------------------------------- marking
+
+    def _visible_from(self, def_node: ast.AST, at: Optional[ast.AST]) -> bool:
+        """Scope filter for by-name resolution: a def is visible from
+        ``at`` when it lives at module level or inside one of ``at``'s
+        enclosing functions. Without this, same-named inner functions in
+        sibling factories (``make_train_step.step`` vs
+        ``make_eval_step.step``) cross-contaminate."""
+        owner = next(enclosing_functions(def_node), None)
+        if owner is None:
+            return True  # module-level defs are visible everywhere
+        if at is None:
+            return False  # module-level reference cannot see nested defs
+        return owner is at or owner in set(enclosing_functions(at))
+
+    def _resolve_funcarg(
+        self,
+        node: ast.AST,
+        at: Optional[ast.AST] = None,
+        seen: Optional[set] = None,
+    ):
+        """Function nodes a call argument may refer to (by-name defs,
+        lambdas, and simple assignment chains), restricted to defs
+        visible from the reference node ``at``."""
+        seen = seen if seen is not None else set()
+        if isinstance(node, ast.Lambda):
+            yield node
+            return
+        if isinstance(node, ast.Call) and is_trace_wrapper(
+            node.func, self.aliases
+        ):
+            # body = jax.checkpoint(step): the inner name is the function.
+            for arg in node.args:
+                yield from self._resolve_funcarg(arg, at, seen)
+            return
+        if not isinstance(node, ast.Name) or node.id in seen:
+            return
+        seen.add(node.id)
+        for d in self._defs_by_name.get(node.id, []):
+            if self._visible_from(d, at):
+                yield d
+        for value in self._assigns.get(node.id, []):
+            yield from self._resolve_funcarg(value, at, seen)
+
+    def _seed(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    target = deco.func if isinstance(deco, ast.Call) else deco
+                    dn = dotted_name(target, self.aliases)
+                    if dn == "functools.partial" and isinstance(deco, ast.Call):
+                        target = deco.args[0] if deco.args else target
+                    if is_trace_wrapper(target, self.aliases):
+                        self.traced.add(node)
+            elif isinstance(node, ast.Call) and is_trace_wrapper(
+                node.func, self.aliases
+            ):
+                at = next(enclosing_functions(node), None)
+                for arg in node.args:
+                    for fn in self._resolve_funcarg(arg, at):
+                        self.traced.add(fn)
+
+    def _propagate(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(self.traced):
+                for node in ast.walk(fn):
+                    if (
+                        isinstance(node, FUNC_NODES)
+                        and node is not fn
+                        and node not in self.traced
+                    ):
+                        self.traced.add(node)
+                        changed = True
+                    elif isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Name
+                    ):
+                        at = next(enclosing_functions(node), None)
+                        for cal in self._defs_by_name.get(node.func.id, []):
+                            if cal not in self.traced and self._visible_from(
+                                cal, at
+                            ):
+                                self.traced.add(cal)
+                                changed = True
+
+    # -------------------------------------------------------------- queries
+
+    def is_traced(self, node: ast.AST) -> bool:
+        """True when ``node`` executes inside any traced function."""
+        if isinstance(node, FUNC_NODES) and node in self.traced:
+            return True
+        return any(fn in self.traced for fn in enclosing_functions(node))
+
+
+@dataclass
+class ModuleContext:
+    """Everything a rule sees for one linted file."""
+
+    path: str  # display path (as passed/discovered, posix separators)
+    tree: ast.AST
+    aliases: dict
+    traced: TracedIndex
+    declared_axes: frozenset  # mesh axis names visible to this lint run
+
+    @classmethod
+    def build(
+        cls, path: str, source: str, declared_axes: frozenset
+    ) -> "ModuleContext":
+        tree = ast.parse(source, filename=path)
+        attach_parents(tree)
+        aliases = collect_aliases(tree)
+        return cls(
+            path=path,
+            tree=tree,
+            aliases=aliases,
+            traced=TracedIndex(tree, aliases),
+            declared_axes=declared_axes,
+        )

@@ -1,0 +1,330 @@
+"""graftlint engine: file discovery, allowlist, rule driving, CLI.
+
+Run as ``python -m raft_ncup_tpu.analysis [paths...]`` (see
+``scripts/lint.sh``); the acceptance contract is that
+``python -m raft_ncup_tpu.analysis raft_ncup_tpu/`` exits 0 on the
+shipped tree. Pure stdlib — linting must work (and stay fast) on hosts
+where importing jax would initialize a wedged accelerator backend.
+
+Allowlist format (default file: ``raft_ncup_tpu/analysis/allowlist.txt``)
+— one audited exception per line::
+
+    path/suffix.py::RULE::qualname  # justification (mandatory)
+
+``qualname`` is the finding's enclosing-function path (``<module>`` at
+top level) or ``*`` to cover the whole file for that rule. The path
+matches by suffix so the file works from any checkout root. Entries
+without a ``#`` justification are a configuration error (exit 2);
+entries that suppress nothing are reported as stale (exit 1 under
+``--strict-allowlist``, warning otherwise).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from raft_ncup_tpu.analysis.astutil import (
+    Finding,
+    ModuleContext,
+    attach_parents,
+    collect_aliases,
+    dotted_name,
+)
+from raft_ncup_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
+
+DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(__file__), "allowlist.txt")
+
+_SKIP_DIRS = frozenset({"__pycache__", ".git", ".venv", "node_modules"})
+
+
+class AllowlistError(Exception):
+    """Malformed allowlist (bad syntax or missing justification)."""
+
+
+@dataclass
+class AllowEntry:
+    path_suffix: str
+    rule: str
+    qual: str
+    justification: str
+    lineno: int
+    used: bool = False
+
+    def matches(self, f: Finding) -> bool:
+        path = f.path.replace("\\", "/")
+        if not (path == self.path_suffix or path.endswith("/" + self.path_suffix)):
+            return False
+        if self.rule != "*" and self.rule != f.rule:
+            return False
+        return self.qual in ("*", f.qualname)
+
+    def render(self) -> str:
+        return f"{self.path_suffix}::{self.rule}::{self.qual} (line {self.lineno})"
+
+
+@dataclass
+class LintResult:
+    findings: list = field(default_factory=list)  # unsuppressed, reportable
+    suppressed: list = field(default_factory=list)  # (finding, entry)
+    stale_entries: list = field(default_factory=list)
+    parse_errors: list = field(default_factory=list)  # (path, message)
+    files_checked: int = 0
+    declared_axes: frozenset = frozenset()
+
+
+def load_allowlist(path: str) -> list:
+    entries = []
+    with open(path, encoding="utf-8") as fh:
+        for lineno, raw in enumerate(fh, start=1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            body, sep, justification = line.partition("#")
+            justification = justification.strip()
+            if not sep or not justification:
+                raise AllowlistError(
+                    f"{path}:{lineno}: allowlist entry has no justification "
+                    "(append `# why this is an audited exception`)"
+                )
+            parts = [p.strip() for p in body.strip().split("::")]
+            if len(parts) == 2:
+                parts.append("*")
+            if len(parts) != 3 or not all(parts[:2]):
+                raise AllowlistError(
+                    f"{path}:{lineno}: expected `path::RULE[::qualname]  "
+                    f"# justification`, got {body.strip()!r}"
+                )
+            path_suffix, rule, qual = parts
+            if rule != "*" and rule not in RULES_BY_ID:
+                raise AllowlistError(
+                    f"{path}:{lineno}: unknown rule {rule!r} "
+                    f"(known: {sorted(RULES_BY_ID)})"
+                )
+            entries.append(
+                AllowEntry(
+                    path_suffix.replace("\\", "/"),
+                    rule,
+                    qual or "*",
+                    justification,
+                    lineno,
+                )
+            )
+    return entries
+
+
+def find_py_files(paths: Sequence[str]) -> list:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in _SKIP_DIRS and not d.startswith(".")
+                )
+                out.extend(
+                    os.path.join(root, n) for n in sorted(names)
+                    if n.endswith(".py")
+                )
+        elif p.endswith(".py"):
+            out.append(p)
+        else:
+            raise FileNotFoundError(f"not a directory or .py file: {p}")
+    # de-dupe while preserving order (overlapping path arguments)
+    seen: set = set()
+    uniq = []
+    for f in out:
+        key = os.path.abspath(f)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(f)
+    return uniq
+
+
+def discover_declared_axes(trees: dict) -> frozenset:
+    """Mesh axis names declared anywhere in the linted set: literal string
+    tuples passed to ``jax.sharding.Mesh`` (positionally or via
+    ``axis_names=``). parallel/mesh.py is the only production declarer."""
+    axes: set = set()
+    for tree, aliases in trees.values():
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func, aliases)
+            if dn is None or dn.split(".")[-1] != "Mesh":
+                continue
+            cand = None
+            if len(node.args) >= 2:
+                cand = node.args[1]
+            for kw in node.keywords:
+                if kw.arg == "axis_names":
+                    cand = kw.value
+            elts = (
+                cand.elts
+                if isinstance(cand, (ast.Tuple, ast.List))
+                else [cand]
+            )
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    axes.add(e.value)
+    return frozenset(axes)
+
+
+def run_lint(
+    paths: Sequence[str],
+    allowlist_path: Optional[str] = None,
+    select: Optional[Sequence[str]] = None,
+    declared_axes: Optional[frozenset] = None,
+) -> LintResult:
+    """Lint ``paths`` and return the full result (the CLI renders it).
+
+    ``select`` restricts to the given rule IDs. ``declared_axes``
+    overrides mesh-axis discovery (fixture tests use this).
+    """
+    result = LintResult()
+    entries = []
+    if allowlist_path:
+        entries = load_allowlist(allowlist_path)
+
+    rules = ALL_RULES
+    if select:
+        unknown = set(select) - set(RULES_BY_ID)
+        if unknown:
+            raise AllowlistError(f"unknown rule id(s): {sorted(unknown)}")
+        rules = tuple(RULES_BY_ID[r] for r in sorted(select))
+
+    # Pass 1: parse everything once (axis discovery needs the full set
+    # before any per-module rule runs).
+    trees: dict = {}
+    for path in find_py_files(paths):
+        display = path.replace("\\", "/")
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError) as e:
+            result.parse_errors.append((display, str(e)))
+            continue
+        trees[display] = (tree, collect_aliases(tree))
+    result.files_checked = len(trees)
+    result.declared_axes = (
+        declared_axes
+        if declared_axes is not None
+        else discover_declared_axes(trees)
+    )
+
+    # Pass 2: rules.
+    from raft_ncup_tpu.analysis.astutil import TracedIndex
+
+    for display, (tree, aliases) in trees.items():
+        attach_parents(tree)
+        ctx = ModuleContext(
+            path=display,
+            tree=tree,
+            aliases=aliases,
+            traced=TracedIndex(tree, aliases),
+            declared_axes=result.declared_axes,
+        )
+        for rule in rules:
+            for finding in rule.check(ctx):
+                entry = next(
+                    (e for e in entries if e.matches(finding)), None
+                )
+                if entry is not None:
+                    entry.used = True
+                    result.suppressed.append((finding, entry))
+                else:
+                    result.findings.append(finding)
+
+    # Staleness is only decidable for entries whose rule actually ran:
+    # under --select, an entry for a deselected rule (or a "*" entry) is
+    # unused because the rule was skipped, not because the finding went
+    # away — marking it stale would fail lint.sh --select spuriously.
+    if select:
+        ran = {r.RULE_ID for r in rules}
+        result.stale_entries = [
+            e for e in entries if not e.used and e.rule in ran
+        ]
+    else:
+        result.stale_entries = [e for e in entries if not e.used]
+    result.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return result
+
+
+def _print_catalog() -> None:
+    print("graftlint rule catalog:")
+    for mod in ALL_RULES:
+        print(f"  {mod.RULE_ID}  {mod.SUMMARY}")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m raft_ncup_tpu.analysis",
+        description="graftlint: JAX-aware static analysis enforcing the "
+        "sync-free, recompile-free hot path (rules JGL001-JGL006).",
+    )
+    parser.add_argument("paths", nargs="*", default=["raft_ncup_tpu"],
+                        help="files/directories to lint (default: the "
+                        "package)")
+    parser.add_argument("--allowlist", default=DEFAULT_ALLOWLIST,
+                        help="audited-exception file (default: "
+                        "%(default)s)")
+    parser.add_argument("--no-allowlist", action="store_true",
+                        help="report raw findings, ignoring the allowlist")
+    parser.add_argument("--select", nargs="+", metavar="RULE",
+                        help="run only these rule IDs")
+    parser.add_argument("--strict-allowlist", action="store_true",
+                        help="fail when an allowlist entry suppresses "
+                        "nothing (stale)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also print allowlisted findings with their "
+                        "justifications")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        _print_catalog()
+        return 0
+
+    allowlist = None if args.no_allowlist else args.allowlist
+    if allowlist and not os.path.exists(allowlist):
+        allowlist = None  # a missing default allowlist is simply empty
+    try:
+        result = run_lint(args.paths, allowlist, args.select)
+    except (AllowlistError, FileNotFoundError) as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    for path, msg in result.parse_errors:
+        print(f"{path}: parse error: {msg}")
+    for f in result.findings:
+        print(f.render())
+    if args.show_suppressed:
+        for f, entry in result.suppressed:
+            print(f"[allowed] {f.render()}  # {entry.justification}")
+    for entry in result.stale_entries:
+        stream = sys.stdout if args.strict_allowlist else sys.stderr
+        print(
+            f"graftlint: stale allowlist entry suppresses nothing: "
+            f"{entry.render()}",
+            file=stream,
+        )
+
+    failed = bool(
+        result.findings
+        or result.parse_errors
+        or (args.strict_allowlist and result.stale_entries)
+    )
+    print(
+        f"graftlint: {result.files_checked} files, "
+        f"{len(result.findings)} finding(s), "
+        f"{len(result.suppressed)} allowlisted, "
+        f"{len(result.stale_entries)} stale allowlist entr(y/ies)",
+        file=sys.stderr,
+    )
+    return 1 if failed else 0

@@ -1,0 +1,30 @@
+"""graftlint rule registry — one module per JGL rule.
+
+Each rule module exposes ``RULE_ID``, ``SUMMARY`` and
+``check(ctx: ModuleContext) -> Iterator[Finding]``. Adding a rule means
+adding a module here and listing it in ``ALL_RULES``; the engine, CLI
+``--select`` filtering, catalog output and tests pick it up from the
+registry.
+"""
+
+from __future__ import annotations
+
+from raft_ncup_tpu.analysis.rules import (
+    jgl001_host_sync,
+    jgl002_donation,
+    jgl003_nondeterminism,
+    jgl004_tracer_control_flow,
+    jgl005_dtype_hygiene,
+    jgl006_partition_axes,
+)
+
+ALL_RULES = (
+    jgl001_host_sync,
+    jgl002_donation,
+    jgl003_nondeterminism,
+    jgl004_tracer_control_flow,
+    jgl005_dtype_hygiene,
+    jgl006_partition_axes,
+)
+
+RULES_BY_ID = {mod.RULE_ID: mod for mod in ALL_RULES}

@@ -1,0 +1,101 @@
+"""JGL001 — host synchronization inside traced code.
+
+``float()``/``int()``/``bool()``/``.item()``/``.tolist()``/``np.asarray``
+(and friends) on a traced value either fail at trace time
+(TracerConversionError) or — worse, on values that happen to be concrete —
+silently bake a device→host round-trip into every execution of the traced
+region. RAFT's scanned GRU refinement is latency-bound (PAPER.md), so one
+stray pull inside the step erases the async pipeline's entire overlap win
+(docs/PERF.md train_loop row). The sanctioned pattern is the Logger's:
+accumulate on device, pull once per window with an explicit
+``jax.device_get`` *outside* the traced region.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from raft_ncup_tpu.analysis.astutil import (
+    Finding,
+    ModuleContext,
+    dotted_name,
+    qualname,
+)
+
+RULE_ID = "JGL001"
+SUMMARY = "host sync (float()/.item()/np.asarray/...) inside traced code"
+
+# Fully-qualified callables that force a transfer or a blocking sync.
+_HOST_PULL_CALLS = frozenset(
+    {
+        "jax.device_get",
+        "jax.block_until_ready",
+        "numpy.asarray",
+        "numpy.array",
+        "numpy.copy",
+        "numpy.save",
+        "numpy.savez",
+    }
+)
+_BUILTIN_CASTS = frozenset({"float", "int", "bool", "complex"})
+_METHOD_PULLS = frozenset({"item", "tolist", "block_until_ready"})
+
+
+def _is_static_arg(node: ast.AST) -> bool:
+    """Casts of literals and len()/shape lookups are trace-time Python,
+    not host syncs."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id == "len"
+    return False
+
+
+def check(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not ctx.traced.is_traced(node):
+            continue
+        dn = dotted_name(node.func, ctx.aliases)
+        if dn in _HOST_PULL_CALLS:
+            yield Finding(
+                ctx.path,
+                node.lineno,
+                node.col_offset,
+                RULE_ID,
+                f"`{dn}` inside traced code forces a host transfer/sync; "
+                "move it outside the traced region (batch explicit pulls "
+                "via one jax.device_get at a window boundary)",
+                qualname(node),
+            )
+        elif (
+            isinstance(node.func, ast.Name)
+            and node.func.id in _BUILTIN_CASTS
+            and node.func.id not in ctx.aliases  # not shadowed by an import
+            and node.args
+            and not _is_static_arg(node.args[0])
+        ):
+            yield Finding(
+                ctx.path,
+                node.lineno,
+                node.col_offset,
+                RULE_ID,
+                f"`{node.func.id}(...)` on a traced value is a per-call "
+                "device→host sync (or a TracerConversionError); keep the "
+                "value on device",
+                qualname(node),
+            )
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _METHOD_PULLS
+            and not node.args
+        ):
+            yield Finding(
+                ctx.path,
+                node.lineno,
+                node.col_offset,
+                RULE_ID,
+                f"`.{node.func.attr}()` inside traced code pulls the value "
+                "to host; keep it on device",
+                qualname(node),
+            )

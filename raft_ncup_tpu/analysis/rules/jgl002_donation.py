@@ -1,0 +1,91 @@
+"""JGL002 — jitted state-carrying step without buffer donation.
+
+A compiled train step that takes the full TrainState and returns the next
+one doubles its parameter+optimizer memory unless the input buffers are
+donated (``donate_argnums``/``donate_argnames``). On TPU that halves the
+largest fittable batch; the repo's contract is that every state-carrying
+step donates (parallel/step.py:87-94). The rule fires on ``jax.jit``/
+``pjit`` applications — call-form or decorator-form — of a function whose
+signature carries a state-like first-class parameter with no donation
+keyword at the jit site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from raft_ncup_tpu.analysis.astutil import (
+    Finding,
+    ModuleContext,
+    dotted_name,
+    enclosing_functions,
+    qualname,
+)
+
+RULE_ID = "JGL002"
+SUMMARY = "jit/pjit of a state-carrying step without donate_argnums"
+
+_JIT_TAILS = frozenset({"jit", "pjit"})
+_DONATE_KWARGS = frozenset({"donate_argnums", "donate_argnames"})
+_STATE_PARAMS = frozenset({"state", "train_state", "opt_state", "carry"})
+
+
+def _is_jit(func_node: ast.AST, aliases: dict) -> bool:
+    dn = dotted_name(func_node, aliases)
+    return dn is not None and dn.split(".")[-1].lstrip("_") in _JIT_TAILS
+
+
+def _state_params(fn: ast.AST) -> list:
+    args = getattr(fn, "args", None)
+    if args is None:
+        return []
+    names = [a.arg for a in args.posonlyargs + args.args]
+    return [n for n in names if n in _STATE_PARAMS]
+
+
+def _finding(ctx: ModuleContext, node: ast.AST, fn_name: str, params) -> Finding:
+    return Finding(
+        ctx.path,
+        node.lineno,
+        node.col_offset,
+        RULE_ID,
+        f"jit of `{fn_name}` carries state parameter(s) "
+        f"{sorted(params)} without donate_argnums/donate_argnames — "
+        "the old state's buffers stay live and double step memory",
+        qualname(node),
+    )
+
+
+def check(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        # Call form: jax.jit(step, ...)
+        if isinstance(node, ast.Call) and _is_jit(node.func, ctx.aliases):
+            if any(kw.arg in _DONATE_KWARGS for kw in node.keywords):
+                continue
+            if not node.args:
+                continue
+            at = next(enclosing_functions(node), None)
+            for fn in ctx.traced._resolve_funcarg(node.args[0], at):
+                params = _state_params(fn)
+                if params:
+                    yield _finding(
+                        ctx, node, getattr(fn, "name", "<lambda>"), params
+                    )
+                    break
+        # Decorator form: @jax.jit / @partial(jax.jit, ...)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = _state_params(node)
+            if not params:
+                continue
+            for deco in node.decorator_list:
+                target, keywords = deco, []
+                if isinstance(deco, ast.Call):
+                    target, keywords = deco.func, deco.keywords
+                    dn = dotted_name(target, ctx.aliases)
+                    if dn == "functools.partial" and deco.args:
+                        target = deco.args[0]
+                if _is_jit(target, ctx.aliases) and not any(
+                    kw.arg in _DONATE_KWARGS for kw in keywords
+                ):
+                    yield _finding(ctx, deco, node.name, params)

@@ -1,0 +1,56 @@
+"""JGL003 — Python-side nondeterminism reachable from traced code.
+
+``time.time()``, stdlib ``random.*`` and ``np.random.*`` inside a traced
+function execute ONCE, at trace time: the sampled value is baked into the
+compiled program as a constant, so every subsequent step reuses it — the
+classic "my noise never changes" bug — and any value drift across
+processes desynchronizes an SPMD pod (each host compiles a different
+constant). Randomness in traced code must flow through keyed
+``jax.random``; wall-clock reads belong on the host side of the step
+boundary.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from raft_ncup_tpu.analysis.astutil import (
+    Finding,
+    ModuleContext,
+    dotted_name,
+    qualname,
+)
+
+RULE_ID = "JGL003"
+SUMMARY = "Python-side nondeterminism (time/random/np.random) in traced code"
+
+_NONDET_PREFIXES = ("time.", "random.", "numpy.random.")
+_NONDET_EXACT = frozenset({"os.urandom", "uuid.uuid4", "secrets.token_bytes"})
+
+
+def _is_nondet(dn: str) -> bool:
+    if dn in _NONDET_EXACT:
+        return True
+    # jax.random is keyed and deterministic — the prefix test must not
+    # catch it ("random." only matches the stdlib module).
+    return any(dn.startswith(p) for p in _NONDET_PREFIXES)
+
+
+def check(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not ctx.traced.is_traced(node):
+            continue
+        dn = dotted_name(node.func, ctx.aliases)
+        if dn is None or not _is_nondet(dn):
+            continue
+        yield Finding(
+            ctx.path,
+            node.lineno,
+            node.col_offset,
+            RULE_ID,
+            f"`{dn}` in traced code executes once at trace time and bakes "
+            "its value into the compiled program; use keyed jax.random "
+            "(or move the read outside the traced region)",
+            qualname(node),
+        )

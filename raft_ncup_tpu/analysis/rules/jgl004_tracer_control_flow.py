@@ -1,0 +1,87 @@
+"""JGL004 — Python control flow on traced values.
+
+``if``/``while`` on a value computed by jax ops inside a traced function
+either raises TracerBoolConversionError at trace time or — when the value
+is concrete because someone already synced it — hides a per-step host
+round-trip behind an innocent-looking branch. Data-dependent control flow
+in traced code must go through ``jax.lax.cond``/``jax.lax.while_loop``
+(or ``jnp.where`` for selects).
+
+Precision note: the rule only fires when the branch test *syntactically
+contains* a jax/jnp call or an array-reduction method call
+(``.any()``/``.all()``/...), so config flags and static-shape branches
+(``if cfg.add_noise:``, ``if H % 8:``) never trigger it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from raft_ncup_tpu.analysis.astutil import (
+    Finding,
+    ModuleContext,
+    dotted_name,
+    qualname,
+)
+
+RULE_ID = "JGL004"
+SUMMARY = "Python if/while on a traced (jax-computed) value"
+
+_REDUCTION_METHODS = frozenset({"any", "all", "sum", "max", "min", "mean"})
+# jax helpers that RETURN static python values — tests on these are fine.
+_STATIC_JAX_CALLS = frozenset(
+    {
+        "jax.process_index",
+        "jax.process_count",
+        "jax.device_count",
+        "jax.local_device_count",
+        "jax.devices",
+        "jax.local_devices",
+        "jax.default_backend",
+    }
+)
+
+
+def _array_call_in(test: ast.AST, aliases: dict) -> Optional[str]:
+    """A jax-call (or reduction-method) subexpression of the branch test,
+    rendered for the message; None when the test looks static."""
+    for sub in ast.walk(test):
+        if not isinstance(sub, ast.Call):
+            continue
+        dn = dotted_name(sub.func, aliases)
+        if dn is not None and dn.split(".")[0] == "jax":
+            if dn in _STATIC_JAX_CALLS:
+                continue
+            return dn
+        if (
+            isinstance(sub.func, ast.Attribute)
+            and sub.func.attr in _REDUCTION_METHODS
+            and not sub.args
+            and not sub.keywords
+        ):
+            return f".{sub.func.attr}()"
+    return None
+
+
+def check(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            continue
+        if not ctx.traced.is_traced(node):
+            continue
+        culprit = _array_call_in(node.test, ctx.aliases)
+        if culprit is None:
+            continue
+        kind = {ast.If: "if", ast.While: "while", ast.IfExp: "conditional"}[
+            type(node)
+        ]
+        yield Finding(
+            ctx.path,
+            node.lineno,
+            node.col_offset,
+            RULE_ID,
+            f"Python `{kind}` on a traced value (`{culprit}`) — use "
+            "jax.lax.cond/while_loop (or jnp.where) inside traced code",
+            qualname(node),
+        )

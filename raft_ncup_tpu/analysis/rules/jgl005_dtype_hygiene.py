@@ -1,0 +1,125 @@
+"""JGL005 — dtype hygiene in the numeric core (``ops/``, ``nn/``).
+
+Two hazards, both of which change the compiled program's signature or
+numerics silently:
+
+- ``jnp.array(...)``/``jnp.asarray(...)`` without an explicit dtype: the
+  result depends on the input's dtype and on ``jax_enable_x64`` — a numpy
+  float64 sneaking in promotes a whole dataflow chain and, worse, changes
+  the jit signature between callers (recompile per caller dtype). In the
+  numeric core every conversion states its dtype.
+- explicit ``float64`` (``np.float64``/``jnp.float64``/``"float64"``):
+  TPUs have no f64 MXU path; XLA emulates it at ~100x cost. f64 in the
+  core is either a bug or belongs behind an allowlist entry explaining
+  why (e.g. a host-side reference check).
+
+Scoped to ``ops/`` and ``nn/`` paths — driver/test code converts freely.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from raft_ncup_tpu.analysis.astutil import (
+    Finding,
+    ModuleContext,
+    dotted_name,
+    qualname,
+)
+
+RULE_ID = "JGL005"
+SUMMARY = "dtype-less jnp.array/asarray or float64 in ops/ and nn/"
+
+_CONVERTERS = frozenset({"jax.numpy.array", "jax.numpy.asarray"})
+_F64_NAMES = frozenset({"numpy.float64", "jax.numpy.float64"})
+
+
+_F64_STRINGS = frozenset({"float64", "f8", "double"})
+
+
+def _in_scope(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return "/ops/" in p or "/nn/" in p or p.startswith(("ops/", "nn/"))
+
+
+def _is_f64_string(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Constant)
+        and isinstance(node.value, str)
+        and node.value in _F64_STRINGS
+    )
+
+
+def _f64_string_in_call(node: ast.Call) -> bool:
+    """String-spelled f64 in dtype position: ``dtype="float64"`` on any
+    call, a second positional on array/asarray (handled by the caller's
+    converter branch), or ``.astype("float64")``."""
+    if any(kw.arg == "dtype" and _is_f64_string(kw.value) for kw in node.keywords):
+        return True
+    if (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr == "astype"
+        and node.args
+        and _is_f64_string(node.args[0])
+    ):
+        return True
+    return False
+
+
+def check(ctx: ModuleContext) -> Iterator[Finding]:
+    if not _in_scope(ctx.path):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func, ctx.aliases)
+            if dn in _CONVERTERS:
+                has_dtype = len(node.args) >= 2 or any(
+                    kw.arg == "dtype" for kw in node.keywords
+                )
+                if not has_dtype:
+                    yield Finding(
+                        ctx.path,
+                        node.lineno,
+                        node.col_offset,
+                        RULE_ID,
+                        f"`{dn.split('.')[-1]}` without an explicit dtype: "
+                        "result dtype depends on the input and on "
+                        "jax_enable_x64 — state it (e.g. jnp.float32)",
+                        qualname(node),
+                    )
+            # String-spelled f64 (dtype="float64" anywhere, a "float64"
+            # second positional on the converters, .astype("float64")) —
+            # the Name/Attribute scan below cannot see string constants.
+            if _f64_string_in_call(node) or (
+                dn in _CONVERTERS
+                and len(node.args) >= 2
+                and _is_f64_string(node.args[1])
+            ):
+                yield Finding(
+                    ctx.path,
+                    node.lineno,
+                    node.col_offset,
+                    RULE_ID,
+                    "string-spelled float64 dtype in the numeric core: "
+                    "TPUs emulate f64 at ~100x cost — use "
+                    "float32/bfloat16 (allowlist deliberate host-side "
+                    "reference checks)",
+                    qualname(node),
+                )
+        dn = (
+            dotted_name(node, ctx.aliases)
+            if isinstance(node, (ast.Name, ast.Attribute))
+            else None
+        )
+        if dn in _F64_NAMES:
+            yield Finding(
+                ctx.path,
+                node.lineno,
+                node.col_offset,
+                RULE_ID,
+                f"`{dn}` in the numeric core: TPUs emulate f64 at ~100x "
+                "cost — use float32/bfloat16 (allowlist deliberate "
+                "host-side reference checks)",
+                qualname(node),
+            )

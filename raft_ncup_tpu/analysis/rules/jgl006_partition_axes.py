@@ -1,0 +1,67 @@
+"""JGL006 — PartitionSpec axis names not declared by the mesh.
+
+Every ``PartitionSpec`` axis string must name an axis of the mesh built in
+``parallel/mesh.py`` (currently ``data``/``spatial``). A typo'd axis name
+does not fail loudly: GSPMD treats the spec as unconstrained, silently
+replicating the array — correctness survives, but the memory/perf plan
+the spec encoded evaporates (a 1080p corr-volume "sharded" over a
+misspelled axis OOMs a chip instead of erroring).
+
+Declared axes are discovered from the lint run itself: any linted module
+constructing ``jax.sharding.Mesh`` with literal axis names contributes
+its names (engine-side; see ``lint.discover_declared_axes``). When no
+declaration is in scope the rule stays silent rather than guessing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from raft_ncup_tpu.analysis.astutil import (
+    Finding,
+    ModuleContext,
+    dotted_name,
+    qualname,
+)
+
+RULE_ID = "JGL006"
+SUMMARY = "PartitionSpec axis name not declared by parallel/mesh.py"
+
+
+def _is_pspec(func_node: ast.AST, aliases: dict) -> bool:
+    dn = dotted_name(func_node, aliases)
+    return dn is not None and dn.split(".")[-1] == "PartitionSpec"
+
+
+def _literal_axes(call: ast.Call) -> Iterator[str]:
+    """String-literal axis names in a PartitionSpec call (including tuple
+    entries: ``P(('data', 'spatial'), None)``). Non-literals (variables)
+    are runtime-determined and skipped."""
+    for arg in call.args:
+        elts = arg.elts if isinstance(arg, (ast.Tuple, ast.List)) else [arg]
+        for e in elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                yield e.value
+
+
+def check(ctx: ModuleContext) -> Iterator[Finding]:
+    if not ctx.declared_axes:
+        return  # no mesh declaration in scope — cannot judge names
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not _is_pspec(
+            node.func, ctx.aliases
+        ):
+            continue
+        for axis in _literal_axes(node):
+            if axis not in ctx.declared_axes:
+                yield Finding(
+                    ctx.path,
+                    node.lineno,
+                    node.col_offset,
+                    RULE_ID,
+                    f"PartitionSpec axis {axis!r} is not a declared mesh "
+                    f"axis ({sorted(ctx.declared_axes)}); GSPMD silently "
+                    "replicates over unknown axes",
+                    qualname(node),
+                )

@@ -176,6 +176,11 @@ def add_train_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--profile_steps", type=int, default=0,
                         help="capture a jax.profiler device trace of this "
                         "many early steps into <run_dir>/profile")
+    parser.add_argument("--strict_guards", action="store_true",
+                        help="assert the sync-free, recompile-free steady "
+                        "state live: implicit host transfers inside the "
+                        "step loop raise, and steady-state recompilation "
+                        "fails the run (analysis/guards.py; docs/ANALYSIS.md)")
 
 
 def model_config_from_args(

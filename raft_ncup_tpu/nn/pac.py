@@ -259,7 +259,7 @@ class PacConvTranspose2d(nn.Module, _PacKernelMixin):
         eye = np.zeros((k * k, self.in_ch, self.out_ch), np.float32)
         for c in range(min(self.in_ch, self.out_ch)):
             eye[:, c, c] = w2
-        return jnp.asarray(eye)
+        return jnp.asarray(eye, jnp.float32)
 
     @nn.compact
     def __call__(self, x: jax.Array, guide: jax.Array) -> jax.Array:

@@ -81,7 +81,7 @@ def smooth_kernel_2d(kind: str) -> jax.Array:
     (reference: core/pac_modules.py:566-580): 'gaussian' is the separable
     [.25, .5, .25] stencil; 'average_{sz}' is a box filter."""
     if kind == "gaussian":
-        s1 = jnp.asarray([0.25, 0.5, 0.25])
+        s1 = jnp.asarray([0.25, 0.5, 0.25], jnp.float32)
     elif kind.startswith("average_"):
         sz = int(kind.split("_")[-1])
         s1 = jnp.full((sz,), 1.0 / sz)

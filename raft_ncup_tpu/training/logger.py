@@ -93,8 +93,13 @@ class Logger:
         if self._steps_last is None:
             self._steps_last = step  # first push after start/resume
         if (step + 1) % self.sum_freq == 0 and self._acc_n:
+            # ONE transfer for the whole window, lr riding along as its
+            # own tree leaf (a dict key would collide with a metric of the
+            # same name): float(lr) on a schedule that returns a device
+            # scalar would be an implicit pull (JGL001's runtime analogue
+            # — guards.py flags it under --strict_guards).
+            sums, lr = jax.device_get((self._acc, lr))
             lr = None if lr is None else float(lr)
-            sums = jax.device_get(self._acc)  # one transfer for the dict
             means = {k: float(v) / self._acc_n for k, v in sums.items()}
             self._acc, self._acc_n = {}, 0
             now = time.perf_counter()

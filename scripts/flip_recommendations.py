@@ -74,6 +74,25 @@ def recommend(record: dict) -> list[str]:
                 "VMEM gating; partial dispatch is by design at large shapes)"
             )
 
+    # Invariant counters from the runtime guards (bench.py train-loop row
+    # under analysis/guards.py): a pipelined-loop number measured while
+    # the sync-free/recompile-free invariant was VIOLATED ranks loops, not
+    # kernels — flag it before anyone reads the train_loop_* fields as a
+    # clean pipeline measurement. (JGL001 audit note: this script itself
+    # is pure host-side JSON analytics — no per-sample device pulls to
+    # batch here; the eval-side ones lived in evaluation.py's
+    # _ShapeCachedForward and are routed through one jax.device_get.)
+    transfers = record.get("train_loop_host_transfers")
+    recompiles = record.get("train_loop_recompiles")
+    if transfers or recompiles:
+        lines.append(
+            "train_loop: INVARIANT VIOLATED during the pipelined window "
+            f"({transfers or 0} implicit host transfer(s), "
+            f"{recompiles or 0} recompile(s)) — the train_loop_* numbers "
+            "measure a stalling loop; fix the leak (see docs/ANALYSIS.md) "
+            "before comparing pipeline rows"
+        )
+
     nc = record.get("pairs_per_sec_nconv_pallas")
     fell_back = record.get("pairs_per_sec_nconv_pallas_FELL_BACK_TO_XLA")
     base = record.get("value")

@@ -1,0 +1,11 @@
+#!/bin/bash
+# graftlint over everything that ships: the package, the drivers, the
+# bench and the scripts. Strict allowlist mode — an entry that no longer
+# suppresses anything must be deleted (or its finding has come back).
+# Rule catalog + allowlist format: docs/ANALYSIS.md.
+set -e
+cd "$(dirname "$0")/.."
+exec python -m raft_ncup_tpu.analysis \
+    --strict-allowlist \
+    raft_ncup_tpu/ train.py evaluate.py demo.py bench.py scripts/ \
+    "$@"

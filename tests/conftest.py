@@ -32,6 +32,26 @@ def rng():
     return np.random.default_rng(1234)
 
 
+@pytest.fixture
+def forbid_host_transfers():
+    """The runtime guard as a fixture: a context-manager factory.
+    ``with forbid_host_transfers() as stats: ...`` raises GuardViolation
+    on any implicit device->host pull inside the scope (explicit
+    jax.device_get stays sanctioned)."""
+    from raft_ncup_tpu.analysis.guards import forbid_host_transfers as fht
+
+    return fht
+
+
+@pytest.fixture
+def max_recompiles():
+    """Compile-budget guard as a fixture: ``with max_recompiles(1): ...``
+    raises GuardViolation when the scope compiles more than n times."""
+    from raft_ncup_tpu.analysis.guards import max_recompiles as mr
+
+    return mr
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "reference: tests that import the read-only reference repo"

@@ -1,0 +1,196 @@
+"""Runtime guard rails (raft_ncup_tpu/analysis/guards.py).
+
+The headline test pins PR 1's zero-per-step-sync claim as a regression
+test: N steady-state training steps through the real pipeline
+(FlowLoader over the synthetic dataset -> DevicePrefetcher -> jitted
+train step -> device-accumulating Logger) run under
+``forbid_host_transfers`` + ``max_recompiles(1)`` — one compile for the
+step, zero forbidden host pulls, the Logger's single explicit
+``jax.device_get`` per sum_freq window being the only sanctioned pull.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_ncup_tpu.analysis.guards import (
+    GuardViolation,
+    RecompileWatchdog,
+    StepGuard,
+    forbid_host_transfers as fht,
+)
+from raft_ncup_tpu.config import TrainConfig, small_model_config
+from raft_ncup_tpu.data import DevicePrefetcher, FlowLoader
+from raft_ncup_tpu.data.synthetic import SyntheticFlowDataset
+from raft_ncup_tpu.parallel.step import make_train_step
+from raft_ncup_tpu.training.logger import Logger
+from raft_ncup_tpu.training.state import create_train_state
+
+
+class TestForbidHostTransfers:
+    def test_catches_float_pull(self, forbid_host_transfers):
+        x = jnp.ones(()) * 2.0
+        with pytest.raises(GuardViolation, match="device->host"):
+            with forbid_host_transfers():
+                float(x)
+
+    def test_catches_np_asarray_pull(self, forbid_host_transfers):
+        with pytest.raises(GuardViolation):
+            with forbid_host_transfers():
+                np.asarray(jnp.arange(4))
+
+    def test_catches_item_and_bool(self, forbid_host_transfers):
+        x = jnp.ones(())
+        with pytest.raises(GuardViolation):
+            with forbid_host_transfers():
+                x.item()
+        with pytest.raises(GuardViolation):
+            with forbid_host_transfers():
+                bool(x > 0)
+
+    def test_explicit_device_get_sanctioned(self, forbid_host_transfers):
+        x = jnp.arange(3)
+        with forbid_host_transfers() as stats:
+            out = jax.device_get(x)
+        np.testing.assert_array_equal(out, [0, 1, 2])
+        assert stats.host_transfers == 0
+        assert stats.sanctioned_gets == 1
+
+    def test_count_mode_does_not_raise(self):
+        x = jnp.ones(())
+        with fht(raise_on_violation=False) as stats:
+            float(x)
+            np.asarray(jnp.ones(2))
+        assert stats.host_transfers == 2
+        assert len(stats.violations) == 2
+
+    def test_uninstalls_cleanly(self, forbid_host_transfers):
+        x = jnp.ones(())
+        with forbid_host_transfers():
+            pass
+        # outside the scope nothing is intercepted
+        assert float(x) == 1.0
+        np.asarray(x)
+
+    def test_device_put_unaffected(self, forbid_host_transfers):
+        # host->device (the prefetcher's direction) is not the guarded
+        # class; the worker thread must keep transferring during a
+        # guarded step.
+        with forbid_host_transfers():
+            y = jax.device_put(np.ones(3, np.float32))
+        assert isinstance(y, jax.Array)
+
+
+class TestRecompileWatchdog:
+    def test_counts_compiles_and_cache_hits(self, max_recompiles):
+        @jax.jit
+        def f(a):
+            return a * 2
+
+        # Inputs created OUTSIDE the scope: jnp.ones itself dispatches a
+        # tiny jitted program whose compile would otherwise be counted.
+        a3, a4 = jnp.ones(3), jnp.ones(4)
+        with max_recompiles(2) as wd:
+            f(a3)
+            f(a3)  # cache hit
+            f(a4)  # new shape
+        assert wd.count == 2
+
+    def test_budget_violation_raises(self, max_recompiles):
+        @jax.jit
+        def f(a):
+            return a + 1
+
+        with pytest.raises(GuardViolation, match="drifting"):
+            with max_recompiles(0):
+                f(jnp.ones(5))
+
+    def test_disarm_gates_counting(self):
+        with RecompileWatchdog() as wd:
+            wd.disarm()
+            jax.jit(lambda a: a - 1)(jnp.ones(6))
+            wd.arm()
+        assert wd.count == 0
+
+
+def test_steady_state_train_loop_sync_free_and_compile_once(tmp_path):
+    """N steady-state steps of the real pipeline under
+    ``forbid_host_transfers`` + ``max_recompiles(1)``: the PR-1 invariant
+    (zero per-step host syncs, no steady-state recompilation) as an
+    executable regression test.
+
+    Two warm-up steps run first, outside the guards — they compile the
+    step and its satellite programs (rng fold-in, the logger's on-device
+    metric adds), exactly like bench.py's warm-up. The guarded window
+    must then run transfer-free with at most the one compile the budget
+    allows (measured: zero)."""
+    B, H, W = 2, 16, 24
+    warmup, steps = 2, 4
+    mcfg = small_model_config(variant="raft")
+    tcfg = TrainConfig(
+        stage="chairs", lr=1e-4, num_steps=50, batch_size=B,
+        image_size=(H, W), iters=2,
+    )
+    model, state = create_train_state(jax.random.key(0), mcfg, tcfg)
+    step = make_train_step(model, tcfg)
+    loader = FlowLoader(
+        SyntheticFlowDataset((H, W), length=8, seed=3),
+        batch_size=B, seed=11, num_workers=2,
+        shard_index=0, num_shards=1,
+    )
+    # sum_freq=2: Logger window boundaries fire INSIDE the guarded run,
+    # proving the explicit batched device_get is the sanctioned channel.
+    logger = Logger(str(tmp_path), sum_freq=2, use_tensorboard=False)
+    from raft_ncup_tpu.analysis.guards import (
+        forbid_host_transfers as fht_ctx,
+        max_recompiles as mr_ctx,
+    )
+
+    with DevicePrefetcher(loader.batches(), depth=2) as pf:
+        for i in range(warmup):
+            rng = jax.random.fold_in(jax.random.key(7), i)
+            state, metrics = step(state, next(pf), rng)
+            logger.push(i, metrics)
+        with fht_ctx() as stats, mr_ctx(1) as wd:
+            for i in range(warmup, warmup + steps):
+                rng = jax.random.fold_in(jax.random.key(7), i)
+                state, metrics = step(state, next(pf), rng)
+                logger.push(i, metrics)
+    logger.close()
+    assert stats.host_transfers == 0, stats.violations
+    assert wd.count <= 1  # steady state: measured 0, budget allows 1
+    # sum_freq=2 boundaries at i=3 and i=5 pulled through the sanctioned
+    # channel only
+    assert stats.sanctioned_gets == steps // 2
+    assert int(state.step) == warmup + steps
+
+
+def test_step_guard_catches_planted_per_step_sync(tmp_path):
+    """Plant the exact regression the guard exists for — a per-step
+    float() on the loss — and watch it trip."""
+    B, H, W = 2, 16, 24
+    mcfg = small_model_config(variant="raft")
+    tcfg = TrainConfig(
+        stage="chairs", lr=1e-4, num_steps=50, batch_size=B,
+        image_size=(H, W), iters=2,
+    )
+    model, state = create_train_state(jax.random.key(0), mcfg, tcfg)
+    step = make_train_step(model, tcfg)
+    loader = FlowLoader(
+        SyntheticFlowDataset((H, W), length=4, seed=3),
+        batch_size=B, seed=11, num_workers=2,
+        shard_index=0, num_shards=1,
+    )
+    with DevicePrefetcher(loader.batches(), depth=2) as pf:
+        with StepGuard() as guard:
+            with pytest.raises(GuardViolation, match="device->host"):
+                with guard.scope():
+                    state, metrics = step(
+                        state, next(pf), jax.random.key(7)
+                    )
+                    float(metrics["loss"])  # the planted per-step sync
+    assert guard.stats.host_transfers == 1

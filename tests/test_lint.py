@@ -1,0 +1,512 @@
+"""graftlint (raft_ncup_tpu/analysis): one positive + one negative fixture
+snippet per JGL rule, engine/allowlist behaviors, and the self-check that
+puts the linter inside the tier-1 gate: the shipped tree lints clean.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from raft_ncup_tpu.analysis.lint import (
+    AllowlistError,
+    load_allowlist,
+    run_lint,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_snippet(tmp_path, source, name="snippet.py", axes=None, select=None):
+    path = tmp_path / name
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    result = run_lint(
+        [str(path)],
+        declared_axes=frozenset(axes) if axes is not None else None,
+        select=select,
+    )
+    assert not result.parse_errors, result.parse_errors
+    return result.findings
+
+
+# --------------------------------------------------------------- JGL001
+
+
+def test_jgl001_flags_host_sync_in_traced_code(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(state, batch):
+            loss = (batch - state).sum()
+            log_val = float(loss)      # per-step sync
+            arr = np.asarray(loss)     # implicit pull
+            scalar = loss.item()       # method pull
+            return loss, log_val, arr, scalar
+        """,
+        select=["JGL001"],
+    )
+    assert [f.rule for f in findings] == ["JGL001"] * 3
+    assert {f.qualname for f in findings} == {"step"}
+
+
+def test_jgl001_ignores_host_side_code(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        def host_loop(step_fn, state, batches):
+            for batch in batches:
+                state, metrics = step_fn(state, batch)
+            return float(np.asarray(metrics))  # host side: fine
+        """,
+    )
+    assert findings == []
+
+
+def test_jgl001_traced_through_scan_and_assignment(tmp_path):
+    """The repo's own pattern: body = jax.checkpoint(step);
+    jax.lax.scan(body, ...) must mark `step` traced."""
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def forward(xs, remat):
+            def step(carry, x):
+                v = carry + x
+                bad = v.item()
+                return v, bad
+
+            body = step
+            if remat:
+                body = jax.checkpoint(step)
+            return jax.lax.scan(body, 0.0, xs)
+        """,
+    )
+    assert [f.rule for f in findings] == ["JGL001"]
+    assert findings[0].qualname == "forward.step"
+
+
+# --------------------------------------------------------------- JGL002
+
+
+def test_jgl002_flags_undonated_state_step(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def make_step(model):
+            def step(state, batch, rng):
+                return state, {}
+
+            return jax.jit(step)
+        """,
+    )
+    assert [f.rule for f in findings] == ["JGL002"]
+    assert "donate" in findings[0].message
+
+
+def test_jgl002_decorator_form_flagged(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def step(state, batch):
+            return state
+        """,
+        select=["JGL002"],
+    )
+    assert [f.rule for f in findings] == ["JGL002"]
+
+
+def test_jgl002_negative_donated_or_stateless(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def make_steps(model):
+            def step(state, batch, rng):
+                return state, {}
+
+            def eval_step(variables, image1, image2):
+                return model(variables, image1, image2)
+
+            donated = jax.jit(step, donate_argnums=0)
+            eval_jit = jax.jit(eval_step)  # no state: nothing to donate
+            return donated, eval_jit
+        """,
+        select=["JGL002"],
+    )
+    assert findings == []
+
+
+def test_jgl002_sibling_scopes_do_not_cross_contaminate(tmp_path):
+    """Same-named inner functions in sibling factories (the repo's
+    make_train_step.step vs make_eval_step.step) must resolve per scope."""
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def make_train_step():
+            def step(state, batch):
+                return state
+
+            return jax.jit(step, donate_argnums=0)
+
+        def make_eval_step():
+            def step(variables, image1):
+                return variables
+
+            return jax.jit(step)
+        """,
+        select=["JGL002"],
+    )
+    assert findings == []
+
+
+# --------------------------------------------------------------- JGL003
+
+
+def test_jgl003_flags_trace_time_nondeterminism(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import time
+        import random
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def step(x):
+            noise = np.random.randn()        # baked at trace time
+            jitter = random.random()         # baked at trace time
+            t = time.time()                  # baked at trace time
+            return x + noise + jitter + t
+        """,
+    )
+    assert [f.rule for f in findings] == ["JGL003"] * 3
+
+
+def test_jgl003_jax_random_is_exempt(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax
+        from jax import random
+
+        @jax.jit
+        def step(x, key):
+            k1, k2 = random.split(key)
+            return x + jax.random.normal(k1, x.shape), k2
+        """,
+        select=["JGL003"],
+    )
+    assert findings == []
+
+
+# --------------------------------------------------------------- JGL004
+
+
+def test_jgl004_flags_python_branch_on_traced_value(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def clamp(x):
+            if jnp.any(x > 10):        # tracer branch
+                x = jnp.clip(x, 0, 10)
+            while (x < 0).all():       # tracer loop
+                x = x + 1
+            return x
+        """,
+    )
+    assert [f.rule for f in findings] == ["JGL004"] * 2
+
+
+def test_jgl004_static_branches_are_fine(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def forward(x, *, test_mode=False, iters=12):
+            if test_mode:              # static python flag
+                iters = 2
+            if x.shape[0] % 8:         # static shape arithmetic
+                raise ValueError("pad first")
+            if jax.process_count() > 1:  # static runtime query
+                pass
+            return x * iters
+        """,
+        select=["JGL004"],
+    )
+    assert findings == []
+
+
+# --------------------------------------------------------------- JGL005
+
+
+def test_jgl005_flags_dtypeless_and_f64_in_ops(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        import numpy as np
+
+        KERNEL = jnp.asarray([0.25, 0.5, 0.25])   # dtype-less
+        BAD = np.float64(1.0)                      # f64 in the core
+
+        def widen(x):
+            return x.astype("float64")             # string-spelled f64
+
+        WIDE = jnp.asarray([1.0], dtype="float64")  # string-spelled f64
+        """,
+        name="ops/constants.py",
+    )
+    assert [f.rule for f in findings] == ["JGL005"] * 4
+
+
+def test_jgl005_negative_explicit_dtype_and_out_of_scope(tmp_path):
+    # explicit dtype in ops/: clean
+    assert (
+        lint_snippet(
+            tmp_path,
+            """
+            import jax.numpy as jnp
+
+            KERNEL = jnp.asarray([0.25, 0.5, 0.25], jnp.float32)
+            IDX = jnp.asarray([1, 2], dtype=jnp.int32)
+            """,
+            name="ops/clean.py",
+        )
+        == []
+    )
+    # dtype-less outside ops//nn/: out of the rule's scope
+    assert (
+        lint_snippet(
+            tmp_path,
+            """
+            import jax.numpy as jnp
+
+            X = jnp.asarray([1.0, 2.0])
+            """,
+            name="drivers/free.py",
+        )
+        == []
+    )
+
+
+# --------------------------------------------------------------- JGL006
+
+
+def test_jgl006_flags_undeclared_axis(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        from jax.sharding import PartitionSpec as P
+
+        SPEC = P("data", "spatail")   # typo: silently replicates
+        """,
+        axes={"data", "spatial"},
+    )
+    assert [f.rule for f in findings] == ["JGL006"]
+    assert "spatail" in findings[0].message
+
+
+def test_jgl006_declared_axes_and_discovery(tmp_path):
+    # declared axes (incl. tuple form and None) are clean
+    assert (
+        lint_snippet(
+            tmp_path,
+            """
+            from jax.sharding import PartitionSpec as P
+
+            A = P("data", "spatial", None)
+            B = P(("data", "spatial"))
+            C = P()
+            """,
+            axes={"data", "spatial"},
+        )
+        == []
+    )
+    # axis names are discovered from a Mesh() declaration in the lint set
+    # (fresh subdir: the snippet above declared data/spatial axes)
+    disc = tmp_path / "disc"
+    disc.mkdir()
+    (disc / "mesh.py").write_text(
+        textwrap.dedent(
+            """
+            from jax.sharding import Mesh
+
+            def make(devices):
+                return Mesh(devices, ("rows", "cols"))
+            """
+        )
+    )
+    (disc / "user.py").write_text(
+        textwrap.dedent(
+            """
+            from jax.sharding import PartitionSpec as P
+
+            GOOD = P("rows")
+            BAD = P("data")
+            """
+        )
+    )
+    result = run_lint([str(disc)])
+    assert result.declared_axes == frozenset({"rows", "cols"})
+    assert [f.rule for f in result.findings] == ["JGL006"]
+    assert "'data'" in result.findings[0].message
+
+
+def test_jgl006_silent_without_declaration(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        from jax.sharding import PartitionSpec as P
+
+        SPEC = P("whatever")
+        """,
+        axes=set(),
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------------- allowlist
+
+
+def test_allowlist_suppresses_with_justification(tmp_path):
+    snippet = tmp_path / "mod.py"
+    snippet.write_text(
+        textwrap.dedent(
+            """
+            import jax
+
+            @jax.jit
+            def step(x):
+                return float(x)
+            """
+        )
+    )
+    allow = tmp_path / "allow.txt"
+    allow.write_text("mod.py::JGL001::step  # audited: test fixture\n")
+    result = run_lint([str(snippet)], allowlist_path=str(allow))
+    assert result.findings == []
+    assert len(result.suppressed) == 1
+    assert result.stale_entries == []
+
+
+def test_allowlist_requires_justification(tmp_path):
+    allow = tmp_path / "allow.txt"
+    allow.write_text("mod.py::JGL001::step\n")
+    with pytest.raises(AllowlistError, match="justification"):
+        load_allowlist(str(allow))
+
+
+def test_allowlist_stale_entry_reported(tmp_path):
+    snippet = tmp_path / "clean.py"
+    snippet.write_text("X = 1\n")
+    allow = tmp_path / "allow.txt"
+    allow.write_text("clean.py::JGL001::*  # obsolete\n")
+    result = run_lint([str(snippet)], allowlist_path=str(allow))
+    assert len(result.stale_entries) == 1
+
+
+def test_allowlist_not_stale_when_rule_deselected(tmp_path):
+    """`--select` must not mark entries of skipped rules stale — lint.sh
+    --select <rule> would otherwise fail spuriously under
+    --strict-allowlist."""
+    snippet = tmp_path / "mod.py"
+    snippet.write_text(
+        textwrap.dedent(
+            """
+            import jax
+
+            @jax.jit
+            def step(x):
+                return float(x)
+            """
+        )
+    )
+    allow = tmp_path / "allow.txt"
+    allow.write_text("mod.py::JGL001::step  # audited: test fixture\n")
+    result = run_lint(
+        [str(snippet)], allowlist_path=str(allow), select=["JGL005"]
+    )
+    assert result.stale_entries == []  # JGL001 never ran: undecidable
+    # ...but with the rule selected and the finding gone, it IS stale
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    (clean / "mod.py").write_text("X = 1\n")
+    result = run_lint(
+        [str(clean / "mod.py")], allowlist_path=str(allow), select=["JGL001"]
+    )
+    assert len(result.stale_entries) == 1
+
+
+def test_parse_error_is_reported_not_raised(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    result = run_lint([str(bad)])
+    assert len(result.parse_errors) == 1
+
+
+# ------------------------------------------------------------ self-check
+
+
+def test_shipped_tree_lints_clean_via_module_cli():
+    """The acceptance contract: `python -m raft_ncup_tpu.analysis
+    raft_ncup_tpu/` exits 0 on the shipped tree (allowlisted exceptions
+    only). Run exactly as documented, from the repo root."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "raft_ncup_tpu.analysis", "raft_ncup_tpu/"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"graftlint found regressions:\n{proc.stdout}\n{proc.stderr}"
+    )
+
+
+def test_drivers_and_scripts_lint_clean():
+    """lint.sh's wider scope (drivers, bench, scripts) stays clean too —
+    in-process, so the tier-1 gate catches driver regressions without a
+    subprocess."""
+    from raft_ncup_tpu.analysis.lint import DEFAULT_ALLOWLIST
+
+    paths = [
+        os.path.join(REPO, p)
+        for p in (
+            "raft_ncup_tpu", "train.py", "evaluate.py", "demo.py",
+            "bench.py", "scripts",
+        )
+    ]
+    result = run_lint(paths, allowlist_path=DEFAULT_ALLOWLIST)
+    assert result.findings == [], [f.render() for f in result.findings]
+    assert result.parse_errors == []
+    assert result.stale_entries == [], [
+        e.render() for e in result.stale_entries
+    ]

@@ -195,8 +195,24 @@ def main(argv=None) -> None:
         mesh=mesh,
         shardings=shardings,
     )
+    # --strict_guards: the invariants graftlint proves statically,
+    # asserted live — implicit host pulls inside the step scope raise
+    # GuardViolation immediately; steady-state recompiles fail the run at
+    # the end-of-loop check. Validation/checkpointing stay outside the
+    # guarded scope (they legitimately pull to host and compile new
+    # shapes). See docs/ANALYSIS.md.
+    step_guard = None
+    guard_scope = contextlib.nullcontext
+    if args.strict_guards:
+        from raft_ncup_tpu.analysis.guards import StepGuard
+
+        step_guard = StepGuard()
+        guard_scope = step_guard.scope
     profiling = False
     profile_scope = contextlib.ExitStack()
+    loop_scope = contextlib.ExitStack()
+    if step_guard is not None:
+        loop_scope.enter_context(step_guard)
     try:
         while step_i < total:
             if args.profile_steps and step_i == start_step + 1:
@@ -207,12 +223,14 @@ def main(argv=None) -> None:
                     trace(os.path.join(run_dir, "profile"))
                 )
                 profiling = True
-            device_batch = next(prefetcher)
-            rng = jax.random.fold_in(
-                jax.random.PRNGKey(train_cfg.seed), step_i
-            )
-            state, metrics = step_fn(state, device_batch, rng)
-            step_i += 1  # host-side counter; int(state.step) would sync
+            with guard_scope():
+                device_batch = next(prefetcher)
+                rng = jax.random.fold_in(
+                    jax.random.PRNGKey(train_cfg.seed), step_i
+                )
+                state, metrics = step_fn(state, device_batch, rng)
+                step_i += 1  # host-side counter; int(state.step) would sync
+                logger.push(step_i - 1, metrics, lr=schedule(step_i - 1))
             if profiling and step_i >= start_step + 1 + args.profile_steps:
                 jax.block_until_ready(metrics["loss"])
                 profile_scope.close()
@@ -220,12 +238,21 @@ def main(argv=None) -> None:
                 logger.write_text(
                     f"profile trace written to {run_dir}/profile"
                 )
-            logger.push(step_i - 1, metrics, lr=schedule(step_i - 1))
             if step_i % train_cfg.val_freq == 0 or step_i == total:
                 ckpt.save(state)
                 ckpt.wait()
                 run_validation(step_i)
+        if step_guard is not None:
+            s = step_guard.stats
+            logger.write_text(
+                f"strict_guards: warmup_compiles={s.warmup_compiles} "
+                f"steady_recompiles={s.recompiles} "
+                f"host_transfers={s.host_transfers} "
+                f"sanctioned_gets={s.sanctioned_gets}"
+            )
+            step_guard.check()  # raises on steady-state recompilation
     finally:
+        loop_scope.close()
         profile_scope.close()
         prefetcher.close()  # joins the worker; closes the batches generator
         ckpt.save(state)
