@@ -293,6 +293,18 @@ def _child_main() -> None:
                 _emit(record)
             except Exception as e:  # never lose the per-step record
                 print(f"train-loop bench failed: {e}", file=sys.stderr)
+        # Checkpoint save/restore latency row (resilience): after the
+        # loop row so it cannot perturb the throughput numbers; a sliver
+        # of budget suffices (one save + one restore of the train state).
+        if (
+            handles is not None
+            and child_budget - (time.monotonic() - t0) > 0.08 * child_budget
+        ):
+            try:
+                record.update(_measure_checkpoint(handles))
+                _emit(record)
+            except Exception as e:  # never lose the earlier rows
+                print(f"checkpoint bench failed: {e}", file=sys.stderr)
 
 
 def _measure_train_step(
@@ -427,12 +439,56 @@ def _measure_train_loop(handles: dict, steps: int | None = None) -> dict:
                 )
             jax.device_get(loss_acc)  # the window's single SANCTIONED sync
             dt = time.perf_counter() - t0
+    # Hand the LIVE carried state back: the loop's donated steps consumed
+    # the buffers `handles["state"]` pointed at, and the checkpoint row
+    # needs a live pytree to save.
+    handles["state"] = holder["state"]
     return {
         "train_loop_pairs_per_sec": round(B * steps / dt, 4),
         "train_loop_ms_per_step": round(dt * 1000.0 / steps, 1),
         "train_loop_steps": steps,
         "train_loop_recompiles": wd.count,
         "train_loop_host_transfers": stats.host_transfers,
+    }
+
+
+def _measure_checkpoint(handles: dict) -> dict:
+    """Time one full-train-state orbax save (+commit wait) and restore at
+    the bench shape — the resilience numbers (docs/RESILIENCE.md):
+    ``ckpt_save_ms`` bounds what a preemption grace window must absorb
+    (preemption saves exactly one checkpoint), and ``ckpt_restore_ms`` is
+    the fixed part of kill/resume overhead (the variable part — process
+    start + jit compile — is amortized by the persistent compilation
+    cache). Runs AFTER the train-loop row on a throwaway directory, so it
+    cannot perturb `train_loop_pairs_per_sec`."""
+    import shutil
+    import tempfile
+
+    from raft_ncup_tpu.training.checkpoint import CheckpointManager
+
+    state = handles["state"]
+    tmp = tempfile.mkdtemp(prefix="bench_ckpt_")
+    mgr = None
+    try:
+        mgr = CheckpointManager(tmp, max_to_keep=1)
+        t0 = time.perf_counter()
+        mgr.save(state)  # synchronous: staging + commit
+        save_ms = (time.perf_counter() - t0) * 1000.0
+        t0 = time.perf_counter()
+        mgr.restore(state)
+        restore_ms = (time.perf_counter() - t0) * 1000.0
+    finally:
+        # Close before rmtree, and on the failure path too — a leaked
+        # manager keeps async-save threads alive under a deleted dir.
+        if mgr is not None:
+            try:
+                mgr.close()
+            except Exception as e:
+                print(f"checkpoint bench close failed: {e}", file=sys.stderr)
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "ckpt_save_ms": round(save_ms, 1),
+        "ckpt_restore_ms": round(restore_ms, 1),
     }
 
 

@@ -3,10 +3,11 @@
 Two layers, one invariant set:
 
 - **graftlint** (``lint.py`` + ``rules/``): AST-based static analysis
-  with JAX-specific rules JGL001-JGL006 — host syncs in traced code,
+  with JAX-specific rules JGL001-JGL007 — host syncs in traced code,
   donation-less state-carrying jits, trace-time nondeterminism, Python
-  control flow on tracers, dtype hygiene in the numeric core, and
-  undeclared PartitionSpec axes. Run it with
+  control flow on tracers, dtype hygiene in the numeric core,
+  undeclared PartitionSpec axes, and swallowed exceptions in the
+  fault-handling layers (resilience//training//data/). Run it with
   ``python -m raft_ncup_tpu.analysis [paths...]``; audited exceptions
   live in ``allowlist.txt``. Pure stdlib — safe on hosts with a wedged
   accelerator backend.

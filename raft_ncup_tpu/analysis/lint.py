@@ -265,7 +265,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m raft_ncup_tpu.analysis",
         description="graftlint: JAX-aware static analysis enforcing the "
-        "sync-free, recompile-free hot path (rules JGL001-JGL006).",
+        "sync-free, recompile-free hot path and honest error handling "
+        "(rules JGL001-JGL007).",
     )
     parser.add_argument("paths", nargs="*", default=["raft_ncup_tpu"],
                         help="files/directories to lint (default: the "

@@ -16,6 +16,7 @@ from raft_ncup_tpu.analysis.rules import (
     jgl004_tracer_control_flow,
     jgl005_dtype_hygiene,
     jgl006_partition_axes,
+    jgl007_swallowed_exceptions,
 )
 
 ALL_RULES = (
@@ -25,6 +26,7 @@ ALL_RULES = (
     jgl004_tracer_control_flow,
     jgl005_dtype_hygiene,
     jgl006_partition_axes,
+    jgl007_swallowed_exceptions,
 )
 
 RULES_BY_ID = {mod.RULE_ID: mod for mod in ALL_RULES}

@@ -136,6 +136,10 @@ def add_data_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--device_prefetch", type=int, default=d.device_prefetch,
                         help="device-side prefetch depth: batches staged on "
                         "device ahead of compute (>=2 hides the transfer)")
+    parser.add_argument("--io_retries", type=int, default=d.io_retries,
+                        help="bounded-backoff retries for failed dataset "
+                        "reads before quarantining the sample "
+                        "(resilience/retry.py)")
     parser.add_argument("--synthetic_ok", action="store_true",
                         help="fall back to procedural data if roots missing")
     parser.add_argument("--synthetic_style", default=d.synthetic_style,
@@ -181,6 +185,33 @@ def add_train_args(parser: argparse.ArgumentParser) -> None:
                         "state live: implicit host transfers inside the "
                         "step loop raise, and steady-state recompilation "
                         "fails the run (analysis/guards.py; docs/ANALYSIS.md)")
+    # --- resilience (resilience/; docs/RESILIENCE.md) ------------------
+    d = TrainConfig()
+    parser.add_argument("--anomaly_sentinel", type=str2bool,
+                        default=d.anomaly_sentinel,
+                        help="fold the divergence sentinel into the jitted "
+                        "step: non-finite loss/grad and grad-norm spikes "
+                        "become skip-updates (state unchanged), counted on "
+                        "device; K consecutive bad steps halt with rollback")
+    parser.add_argument("--sentinel_spike_factor", type=float,
+                        default=d.sentinel_spike_factor,
+                        help="grad-norm above this multiple of its EMA "
+                        "counts as a bad step")
+    parser.add_argument("--sentinel_ema_decay", type=float,
+                        default=d.sentinel_ema_decay)
+    parser.add_argument("--sentinel_warmup", type=int,
+                        default=d.sentinel_warmup,
+                        help="good steps before spike detection arms")
+    parser.add_argument("--sentinel_halt_after", type=int,
+                        default=d.sentinel_halt_after,
+                        help="consecutive bad steps that halt the run "
+                        "(exit code 76, rollback to last good checkpoint)")
+    parser.add_argument("--chaos",
+                        default=os.environ.get("RAFT_NCUP_CHAOS"),
+                        help="deterministic fault injection for resilience "
+                        "tests: comma-joined nan@STEP / ioerror@READ / "
+                        "sigterm@STEP (resilience/chaos.py; env fallback "
+                        "RAFT_NCUP_CHAOS)")
 
 
 def model_config_from_args(
@@ -256,6 +287,11 @@ def train_config_from_args(args: argparse.Namespace) -> TrainConfig:
         checkpoint_dir=args.checkpoint_dir,
         data_parallel=args.data_parallel,
         spatial_parallel=args.spatial_parallel,
+        anomaly_sentinel=args.anomaly_sentinel,
+        sentinel_spike_factor=args.sentinel_spike_factor,
+        sentinel_ema_decay=args.sentinel_ema_decay,
+        sentinel_warmup=args.sentinel_warmup,
+        sentinel_halt_after=args.sentinel_halt_after,
     )
 
 
@@ -270,6 +306,7 @@ def data_config_from_args(args: argparse.Namespace) -> DataConfig:
         compressed_ft=args.compressed_ft,
         num_workers=args.num_workers,
         device_prefetch=args.device_prefetch,
+        io_retries=args.io_retries,
         synthetic_ok=args.synthetic_ok,
         synthetic_style=args.synthetic_style,
     )

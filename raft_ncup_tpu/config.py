@@ -161,6 +161,18 @@ class TrainConfig:
     # parallelism: data-parallel size (None = all devices) and spatial size.
     data_parallel: int | None = None
     spatial_parallel: int = 1
+    # --- divergence sentinel (resilience/anomaly.py; docs/RESILIENCE.md).
+    # Folded into the jitted step when enabled: non-finite loss/grad and
+    # grad-norm spikes become skip-updates (state unchanged), counted on
+    # device; K consecutive bad steps halt the run with a rollback.
+    # Default ON so the CLI, the library, and the bench all compile the
+    # SAME production step program — a sentinel-off bench would never see
+    # a sentinel-induced throughput regression.
+    anomaly_sentinel: bool = True
+    sentinel_spike_factor: float = 20.0  # grad_norm > factor * EMA = spike
+    sentinel_ema_decay: float = 0.99
+    sentinel_warmup: int = 10  # good steps before spike detection arms
+    sentinel_halt_after: int = 10  # K consecutive bad steps => halt
 
     @property
     def total_schedule_steps(self) -> int:
@@ -186,6 +198,11 @@ class DataConfig:
     # in flight while the next transfers, so the accelerator never waits
     # on host→device transfer in steady state.
     device_prefetch: int = 2
+    # Transient-IO resilience (resilience/retry.py): failed dataset reads
+    # are retried with exponential backoff this many times before the
+    # sample is quarantined and substituted; accounting lands in log.txt.
+    io_retries: int = 3
+    io_retry_backoff_s: float = 0.05
     # When no dataset is present on disk, the loader can serve procedurally
     # generated pairs so training/benchmarking still exercises the full path.
     synthetic_ok: bool = False

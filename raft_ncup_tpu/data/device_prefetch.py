@@ -35,6 +35,7 @@ single-process path, ``jax.make_array_from_process_local_data`` on a pod.
 from __future__ import annotations
 
 import queue
+import sys
 import threading
 from typing import Any, Iterable, Iterator, Mapping, Optional
 
@@ -121,13 +122,19 @@ class DevicePrefetcher:
         finally:
             # The worker is the only thread ever executing the wrapped
             # generator, and it is suspended (not executing) here — so
-            # this is the one place its close() is always legal.
+            # this is the one place its close() is always legal. A close
+            # failure has no consumer left to surface to, but it must not
+            # vanish either (JGL007): log it to stderr.
             close = getattr(self._it, "close", None)
             if close is not None:
                 try:
                     close()
-                except Exception:
-                    pass
+                except Exception as e:
+                    print(
+                        f"device-prefetch: wrapped iterator close failed: "
+                        f"{e}",
+                        file=sys.stderr,
+                    )
 
     # -------------------------------------------------------- consumer side
 

@@ -16,11 +16,14 @@ of worker scheduling, stable across restarts.
 from __future__ import annotations
 
 import queue
+import sys
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, Optional
 
 import numpy as np
+
+from raft_ncup_tpu.resilience.retry import RetryStats, retry_io
 
 
 def _stack_batch(samples: list[dict]) -> dict:
@@ -60,6 +63,8 @@ class FlowLoader:
         prefetch: int = 2,
         shard_index: Optional[int] = None,
         num_shards: Optional[int] = None,
+        io_retries: int = 3,
+        io_retry_backoff_s: float = 0.05,
     ):
         if shard_index is None or num_shards is None:
             import jax
@@ -77,6 +82,17 @@ class FlowLoader:
         self.prefetch = prefetch
         self.shard_index = shard_index
         self.num_shards = num_shards
+        # Transient-IO resilience (resilience/retry.py): reads retry with
+        # bounded backoff; samples that keep failing are quarantined for
+        # the rest of the run and substituted so batches keep their
+        # shape. `retry_stats` is this run's accounting (log.txt).
+        self.io_retries = io_retries
+        self.io_retry_backoff_s = io_retry_backoff_s
+        self.retry_stats = RetryStats()
+        # Guarded by _io_lock: pool workers fail concurrently, and the
+        # check-then-quarantine must not double-quarantine an index.
+        self._quarantined: set = set()
+        self._io_lock = threading.Lock()
         if len(self) == 0:
             raise ValueError(
                 f"dataset of {len(dataset)} samples yields zero batches for "
@@ -105,11 +121,89 @@ class FlowLoader:
             order = np.arange(n)
         return order[self.shard_index :: self.num_shards]
 
-    def _load_one(self, epoch: int, index: int) -> dict:
-        rng = np.random.default_rng(
-            np.random.SeedSequence([self.seed, epoch, int(index)])
+    def _read_sample(self, epoch: int, index: int) -> dict:
+        """One retried dataset read. The augmentation rng is rebuilt
+        from (seed, epoch, index) INSIDE every attempt: a sample() that
+        consumed random draws before hitting a transient error would
+        otherwise hand its retry an advanced generator, silently
+        breaking the loader's per-(seed, epoch, index) determinism —
+        and with it the bitwise kill/resume guarantee."""
+
+        def attempt() -> dict:
+            rng = np.random.default_rng(
+                np.random.SeedSequence([self.seed, epoch, index])
+            )
+            return self.dataset.sample(index, rng)
+
+        return retry_io(
+            attempt,
+            attempts=self.io_retries,
+            base_delay_s=self.io_retry_backoff_s,
+            stats=self.retry_stats,
+            desc=f"dataset read index={index}",
+            log=self._log_retry,
         )
-        return self.dataset.sample(int(index), rng)
+
+    def _quarantine(self, index: int, why: str) -> None:
+        with self._io_lock:
+            already = index in self._quarantined
+            self._quarantined.add(index)
+        if not already:
+            self.retry_stats.quarantine(index)
+            self._log_retry(f"dataset read index={index} {why}; quarantined")
+
+    def _load_one(self, epoch: int, index: int) -> dict:
+        index = int(index)
+        with self._io_lock:
+            quarantined = index in self._quarantined
+        if quarantined:
+            return self._substitute(epoch, index)
+        try:
+            return self._read_sample(epoch, index)
+        except OSError as e:
+            # Poison sample: the read failed through every retry. Losing
+            # one sample must not kill a 100k-step run — quarantine the
+            # index (never read again this run) and substitute a
+            # neighbor so the batch keeps its shape. The quarantine list
+            # is accounted in retry_stats and surfaced in log.txt.
+            self._quarantine(index, f"failed permanently ({e})")
+            return self._substitute(epoch, index)
+
+    def _substitute(self, epoch: int, index: int) -> dict:
+        """Deterministic stand-in for a quarantined sample: the next
+        non-quarantined index of THIS host's epoch shard (wrapping,
+        shard order) — never an index another host also serves, so a
+        multihost global batch cannot double-load a sample. Read through
+        the same retry/quarantine policy (with the substitute's own
+        (seed, epoch, sub) rng), so a flaky substitute read cannot kill
+        the run either. When every shard index ends up quarantined the
+        data source is gone, not flaky: raise a clear error instead of
+        spinning."""
+        shard = self._epoch_indices(epoch)
+        hits = np.nonzero(shard == index)[0]
+        pos = int(hits[0]) if len(hits) else 0
+        for off in range(1, len(shard)):
+            sub = int(shard[(pos + off) % len(shard)])
+            with self._io_lock:
+                quarantined = sub in self._quarantined
+            if quarantined:
+                continue
+            try:
+                return self._read_sample(epoch, sub)
+            except OSError as e:
+                self._quarantine(sub, f"failed permanently ({e})")
+        raise RuntimeError(
+            f"all {len(self._quarantined)} reachable shard samples are "
+            "quarantined after exhausting IO retries — the data source "
+            "is unavailable, not flaky "
+            f"({self.retry_stats.summary()})"
+        )
+
+    @staticmethod
+    def _log_retry(msg: str) -> None:
+        # stderr: stdout is a parsed protocol stream in the harnesses
+        # that wrap child trainers (bench JSON tail, LOSS= lines).
+        print(f"FlowLoader {msg}", file=sys.stderr)
 
     def batches(
         self, start_epoch: int = 0, start_batch: int = 0

@@ -24,8 +24,33 @@ from jax.sharding import Mesh
 from raft_ncup_tpu.config import TrainConfig
 from raft_ncup_tpu.models.raft import RAFT
 from raft_ncup_tpu.parallel.mesh import batch_sharding, replicated
+from raft_ncup_tpu.resilience.anomaly import guard_update
 from raft_ncup_tpu.training.loss import sequence_loss
 from raft_ncup_tpu.training.state import TrainState
+
+
+# Step-function reuse across trainer invocations in one process: two
+# models with equal ModelConfig compute identically (flax modules carry
+# only their config), so the jitted step — and, with the shared
+# optimizer transform from training/optim.py, its compiled executable —
+# can be reused instead of re-traced. This is what makes an in-process
+# kill/resume cycle (resilience tests, notebook restarts) pay restore
+# latency rather than a full recompile. Keyed on every config field the
+# traced step reads; bounded FIFO so a config-sweeping process cannot
+# pin unboundedly many executables (callers keep their own references —
+# eviction only means a later identical request re-traces).
+_STEP_CACHE: dict = {}
+_STEP_CACHE_MAX = 8
+
+
+def _step_cache_key(model_cfg, cfg: TrainConfig, mesh) -> tuple:
+    return (
+        model_cfg, mesh,
+        cfg.stage != "chairs",  # freeze_bn (reference: train.py:185-186)
+        cfg.add_noise, cfg.iters, cfg.gamma, cfg.max_flow,
+        cfg.anomaly_sentinel, cfg.sentinel_spike_factor,
+        cfg.sentinel_ema_decay, cfg.sentinel_warmup,
+    )
 
 
 def make_train_step(
@@ -39,6 +64,10 @@ def make_train_step(
     [0, 255] (the loader ships uint8; the cast happens on device), flow
     (B, H, W, 2), valid (B, H, W).
     """
+    cache_key = _step_cache_key(model.cfg, cfg, mesh)
+    cached = _STEP_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
     freeze_bn = cfg.stage != "chairs"  # reference: train.py:185-186
 
     def loss_fn(params, batch_stats, batch, rng):
@@ -77,21 +106,34 @@ def make_train_step(
         (loss, (metrics, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params, state.batch_stats, batch, rng)
-        state = state.apply_gradients(grads, new_batch_stats=new_stats)
+        new_state = state.apply_gradients(grads, new_batch_stats=new_stats)
         metrics = dict(metrics)
         metrics["loss"] = loss
         metrics["grad_norm"] = optax.global_norm(grads)
-        return state, metrics
+        if cfg.anomaly_sentinel:  # static flag: one fixed compiled program
+            # Divergence sentinel (resilience/anomaly.py): a non-finite or
+            # grad-spiking step selects the OLD params/opt_state via
+            # jnp.where — fully on device, no host sync, no extra program.
+            new_state, sen_metrics = guard_update(
+                state, new_state, loss, metrics["grad_norm"], cfg
+            )
+            metrics.update(sen_metrics)
+        return new_state, metrics
 
     if mesh is None:
-        return jax.jit(step, donate_argnums=0)
-    repl = replicated(mesh)
-    return jax.jit(
-        step,
-        in_shardings=(repl, batch_sharding(mesh), repl),
-        out_shardings=(repl, repl),
-        donate_argnums=0,
-    )
+        jitted = jax.jit(step, donate_argnums=0)
+    else:
+        repl = replicated(mesh)
+        jitted = jax.jit(
+            step,
+            in_shardings=(repl, batch_sharding(mesh), repl),
+            out_shardings=(repl, repl),
+            donate_argnums=0,
+        )
+    while len(_STEP_CACHE) >= _STEP_CACHE_MAX:
+        _STEP_CACHE.pop(next(iter(_STEP_CACHE)))
+    _STEP_CACHE[cache_key] = jitted
+    return jitted
 
 
 def make_synthetic_batch(rng: jax.Array, batch: int, height: int, width: int):

@@ -1,0 +1,57 @@
+"""Fault tolerance for long training runs.
+
+RAFT-style schedules run 100k+ steps; on preemptible TPU pods eviction
+mid-run is the norm, a single non-finite batch can poison the optimizer
+state, and a flaky dataset read should never cost `val_freq` steps of
+work. This package makes those events first-class:
+
+- :mod:`anomaly` — an on-device divergence sentinel folded into the
+  jitted train step: non-finite loss/grad and grad-norm spikes select a
+  ``jnp.where`` skip-update (params/opt_state/batch_stats unchanged),
+  counters accumulate on device and are pulled only at the existing
+  per-window sanctioned ``jax.device_get`` boundary, so the
+  zero-host-sync / zero-recompile invariants (docs/ANALYSIS.md) hold.
+- :mod:`preemption` — SIGTERM/SIGINT handlers that set a flag checked at
+  the step boundary; the run saves one atomic (multihost-agreed)
+  checkpoint plus exact-resume metadata and exits with
+  :data:`EXIT_PREEMPTED`.
+- :mod:`retry` — bounded exponential-backoff retry for host-side IO
+  (dataset reads, checkpoint saves) with poison-sample quarantine and
+  per-run accounting (``RetryStats`` lands in log.txt).
+- :mod:`chaos` — deterministic fault injection (NaN batches, IOError on
+  the Nth read, SIGTERM at step N) driving the end-to-end resilience
+  tests against the real synthetic pipeline.
+
+Protocol and knobs: docs/RESILIENCE.md.
+"""
+
+from raft_ncup_tpu.resilience.anomaly import (  # noqa: F401
+    guard_update,
+    init_sentinel,
+)
+from raft_ncup_tpu.resilience.chaos import (  # noqa: F401
+    ChaosDataset,
+    ChaosSpec,
+    chaos_batches,
+)
+from raft_ncup_tpu.resilience.preemption import (  # noqa: F401
+    EXIT_DIVERGED,
+    EXIT_PREEMPTED,
+    PreemptionHandler,
+    resume_metadata,
+)
+from raft_ncup_tpu.resilience.retry import RetryStats, retry_io  # noqa: F401
+
+__all__ = [
+    "ChaosDataset",
+    "ChaosSpec",
+    "EXIT_DIVERGED",
+    "EXIT_PREEMPTED",
+    "PreemptionHandler",
+    "RetryStats",
+    "chaos_batches",
+    "guard_update",
+    "init_sentinel",
+    "resume_metadata",
+    "retry_io",
+]

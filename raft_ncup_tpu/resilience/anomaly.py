@@ -1,0 +1,104 @@
+"""On-device divergence sentinel, folded INTO the jitted train step.
+
+A non-finite loss or gradient (bad batch, fp overflow) poisons Adam's
+moments the moment ``apply_gradients`` runs — and a naive host-side
+check (``if not np.isfinite(float(loss))``) would reintroduce the exact
+per-step device→host sync the async pipeline removed (PR 1) and the
+guards forbid (PR 2). So the sentinel lives inside the compiled step:
+
+- **detect** — ``bad = ~isfinite(loss) | ~isfinite(grad_norm) | spike``,
+  where a *spike* is a grad norm above ``sentinel_spike_factor`` times
+  its EMA (armed only after ``sentinel_warmup`` good steps, so init
+  noise never trips it);
+- **skip-update** — every leaf of params / opt_state / batch_stats is
+  ``jnp.where(bad, old, new)``: a bad step leaves the train state
+  untouched bit-for-bit (the step counter still advances — it counts
+  *attempted* steps, which is what the resumable data-stream position is
+  derived from);
+- **account on device** — skipped/consecutive/EMA counters ride the
+  sentinel pytree carried in ``TrainState.sentinel``; the host reads
+  them only at the per-window sanctioned ``jax.device_get`` boundary
+  (train.py, same cadence as the Logger's single pull), so steady-state
+  host transfers and recompiles stay 0 under ``--strict_guards``.
+
+The *halt* policy (``sentinel_halt_after`` consecutive bad steps ⇒ stop
+the run, roll back to the last good checkpoint, exit
+:data:`raft_ncup_tpu.resilience.preemption.EXIT_DIVERGED`) is host-side
+policy in train.py — by the skip-update invariant the in-memory params
+are still last-good, but a persistent bad streak means the *inputs* or
+the run itself have gone wrong, and burning compute on skipped steps
+helps nobody. Semantics: docs/RESILIENCE.md.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_sentinel() -> dict:
+    """Initial sentinel accumulator pytree (carried in TrainState)."""
+    return {
+        "skipped": jnp.zeros((), jnp.int32),  # cumulative skipped steps
+        "consecutive": jnp.zeros((), jnp.int32),  # current bad streak
+        "good": jnp.zeros((), jnp.int32),  # good steps seen (EMA warm-up)
+        "ema_grad_norm": jnp.zeros((), jnp.float32),
+    }
+
+
+def guard_update(
+    prev_state: Any,
+    new_state: Any,
+    loss: jax.Array,
+    grad_norm: jax.Array,
+    cfg: Any,
+) -> Tuple[Any, dict]:
+    """Select between ``new_state`` (good step) and ``prev_state``'s
+    params/opt_state/batch_stats (bad step), update the sentinel
+    accumulators, and return ``(state, sentinel_metrics)``.
+
+    Traced code: runs inside the jitted step, one fixed program — the
+    skip is a data-dependent ``jnp.where``, never Python control flow.
+    ``cfg`` supplies ``sentinel_spike_factor`` / ``sentinel_ema_decay`` /
+    ``sentinel_warmup`` (TrainConfig).
+    """
+    sen = prev_state.sentinel
+    finite = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+    warmed = sen["good"] >= cfg.sentinel_warmup
+    spike = warmed & (
+        grad_norm > cfg.sentinel_spike_factor * sen["ema_grad_norm"]
+    )
+    bad = jnp.logical_or(~finite, spike)
+
+    def keep_good(new, old):
+        return jax.tree.map(lambda n, o: jnp.where(bad, o, n), new, old)
+
+    bad_i = bad.astype(jnp.int32)
+    decay = jnp.float32(cfg.sentinel_ema_decay)
+    ema = jnp.where(
+        bad,
+        sen["ema_grad_norm"],
+        jnp.where(
+            sen["good"] == 0,
+            grad_norm,  # first good step seeds the EMA
+            decay * sen["ema_grad_norm"] + (1.0 - decay) * grad_norm,
+        ),
+    )
+    sentinel = {
+        "skipped": sen["skipped"] + bad_i,
+        "consecutive": jnp.where(bad, sen["consecutive"] + 1, 0),
+        "good": sen["good"] + (1 - bad_i),
+        "ema_grad_norm": ema,
+    }
+    state = new_state.replace(
+        params=keep_good(new_state.params, prev_state.params),
+        opt_state=keep_good(new_state.opt_state, prev_state.opt_state),
+        batch_stats=keep_good(new_state.batch_stats, prev_state.batch_stats),
+        sentinel=sentinel,
+    )
+    # bad_step means over a Logger window = fraction of the window
+    # skipped; 0.0000 in a healthy run.
+    metrics = {"bad_step": bad.astype(jnp.float32)}
+    return state, metrics

@@ -1,0 +1,142 @@
+"""Deterministic fault injection: the chaos harness the tests drive.
+
+Every resilience claim in this package is backed by an end-to-end test
+that injects the fault into the REAL pipeline (synthetic dataset →
+FlowLoader → DevicePrefetcher → jitted step) and asserts the documented
+recovery. Faults are addressed by deterministic coordinates — a step
+number or a global read count — so a failing test replays exactly.
+
+Spec grammar (``--chaos`` flag / ``RAFT_NCUP_CHAOS`` env), comma-joined:
+
+- ``nan@S`` — the batch consumed by (0-based) training step ``S`` gets
+  an all-NaN flow field → non-finite loss/grads → the sentinel must
+  skip-update (anomaly.py).
+- ``ioerror@N`` — the ``N``-th (0-based, global) ``dataset.sample``
+  read raises ``IOError`` → the loader must retry with backoff
+  (retry.py) and the run must be unaffected.
+- ``sigterm@S`` — a real SIGTERM is delivered to the training process
+  right after it completes ``S`` attempted steps → the preemption path
+  must save an atomic checkpoint and exit :data:`EXIT_PREEMPTED`.
+  (The self-``os.kill`` exercises the same signal machinery as an
+  external kill; tests/test_chaos_train.py also covers the
+  child-process external-SIGTERM variant.)
+
+NaN injection wraps the *host batch stream* (order-preserving, so batch
+``i`` of the stream is exactly the batch step ``start_step + i``
+consumes, prefetch depth notwithstanding); the SIGTERM trigger lives in
+the train loop itself so it lands on a precise step boundary. Usage:
+docs/RESILIENCE.md.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+ENV_VAR = "RAFT_NCUP_CHAOS"
+
+_KINDS = ("nan", "ioerror", "sigterm")
+
+
+@dataclass(frozen=True)
+class ChaosSpec:
+    """Parsed fault-injection plan. Empty spec = no chaos."""
+
+    nan_steps: frozenset = frozenset()
+    ioerror_reads: frozenset = frozenset()
+    sigterm_after: Optional[int] = None
+
+    @classmethod
+    def parse(cls, spec: Optional[str]) -> "ChaosSpec":
+        nan: set = set()
+        ioe: set = set()
+        sig: Optional[int] = None
+        for token in (spec or "").split(","):
+            token = token.strip()
+            if not token:
+                continue
+            kind, sep, value = token.partition("@")
+            if not sep or kind not in _KINDS:
+                raise ValueError(
+                    f"bad chaos event {token!r} (want one of "
+                    f"{'/'.join(_KINDS)}@N, comma-joined)"
+                )
+            n = int(value)
+            if kind == "nan":
+                nan.add(n)
+            elif kind == "ioerror":
+                ioe.add(n)
+            else:
+                sig = n
+        return cls(frozenset(nan), frozenset(ioe), sig)
+
+    @property
+    def active(self) -> bool:
+        return bool(self.nan_steps or self.ioerror_reads
+                    or self.sigterm_after is not None)
+
+    def render(self) -> str:
+        parts = [f"nan@{s}" for s in sorted(self.nan_steps)]
+        parts += [f"ioerror@{n}" for n in sorted(self.ioerror_reads)]
+        if self.sigterm_after is not None:
+            parts.append(f"sigterm@{self.sigterm_after}")
+        return ",".join(parts) or "<none>"
+
+
+def chaos_batches(
+    batches: Iterable[dict],
+    nan_steps: frozenset,
+    start_step: int = 0,
+    log: Optional[Callable[[str], None]] = None,
+) -> Iterator[dict]:
+    """Wrap a host-batch stream, poisoning the flow of selected steps.
+
+    Batch ``i`` of the stream is the one training step ``start_step + i``
+    consumes (the loader/prefetcher are order-preserving), so ``nan@S``
+    lands on exactly step ``S`` regardless of prefetch depth.
+    """
+    for i, batch in enumerate(batches):
+        step = start_step + i
+        if step in nan_steps:
+            batch = dict(batch)
+            flow = np.array(batch["flow"], dtype=np.float32, copy=True)
+            flow[...] = np.nan
+            batch["flow"] = flow
+            if log is not None:
+                log(f"chaos: NaN flow injected into the batch for step {step}")
+        yield batch
+
+
+class ChaosDataset:
+    """Dataset wrapper raising ``IOError`` on configured global reads.
+
+    The read counter is process-global across loader worker threads
+    (lock-guarded), so ``ioerror@N`` means "the N-th sample() call this
+    process makes", independent of which worker lands on it.
+    """
+
+    def __init__(self, dataset, ioerror_reads: frozenset):
+        self._dataset = dataset
+        self._fail = frozenset(int(n) for n in ioerror_reads)
+        self._lock = threading.Lock()
+        self._reads = 0
+
+    def __len__(self) -> int:
+        return len(self._dataset)
+
+    def __getattr__(self, name):  # is_test etc. pass through
+        return getattr(self._dataset, name)
+
+    def sample(self, index: int, rng=None):
+        with self._lock:
+            n = self._reads
+            self._reads += 1
+        if n in self._fail:
+            raise IOError(
+                f"chaos: injected IOError on dataset read {n} "
+                f"(sample index {index})"
+            )
+        return self._dataset.sample(index, rng)

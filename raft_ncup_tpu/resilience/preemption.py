@@ -1,0 +1,134 @@
+"""Preemption-safe shutdown: signal handling, agreed save, exact resume.
+
+Preemptible TPU pods deliver SIGTERM with a grace window; a run that
+dies mid-step throws away up to ``val_freq`` steps of work and — worse —
+can leave a half-written "latest" state. The protocol here:
+
+1. :class:`PreemptionHandler` turns SIGTERM/SIGINT into a *flag*; the
+   train loop checks it at the step boundary (signal handlers must never
+   touch jax — the interrupted frame may be mid-dispatch).
+2. On a flagged boundary the driver saves ONE atomic checkpoint (orbax's
+   per-step directory commit) and exits with :data:`EXIT_PREEMPTED` so
+   the scheduler can tell "requeue me" from a crash.
+3. Multi-host, the flag is *agreed* before anyone saves: orbax saves are
+   collective, so a host acting alone on its local signal would wedge
+   the pod. ``poll`` all-reduces the flag at a fixed step cadence —
+   every process breaks at the same step and saves the same step.
+4. :func:`resume_metadata` pins the run's identity (model variant,
+   config fingerprint, seed) next to the orbax payload; restore verifies
+   it (training/checkpoint.py) and fails with a *clear* message on
+   mismatch instead of orbax's opaque pytree-structure error.
+
+Exit-code registry (distinct from 0/1 so wrappers can branch):
+``EXIT_PREEMPTED`` — clean preemption shutdown, checkpoint saved, safe
+to requeue; ``EXIT_DIVERGED`` — sentinel halt (anomaly.py), rolled back
+to the last good checkpoint, requeueing without investigation will
+likely diverge again. Protocol details: docs/RESILIENCE.md.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import signal
+import sys
+from typing import Any, Optional, Sequence
+
+# BSD sysexits-adjacent, away from shell/python conventions (1/2/126+).
+EXIT_PREEMPTED = 75  # EX_TEMPFAIL: re-runnable, state saved
+EXIT_DIVERGED = 76  # EX_PROTOCOL: training diverged, rolled back
+
+
+def resume_metadata(model_cfg: Any, train_cfg: Any) -> dict:
+    """The identity blob saved next to the orbax payload and verified on
+    restore: enough to refuse a wrong-architecture / wrong-seed resume
+    before orbax dives into the pytree."""
+    from raft_ncup_tpu.config import config_to_json
+
+    fingerprint = hashlib.sha256(
+        config_to_json(model_cfg).encode("utf-8")
+    ).hexdigest()[:16]
+    return {
+        "model_variant": model_cfg.variant,
+        "config_fingerprint": fingerprint,
+        "seed": int(train_cfg.seed),
+    }
+
+
+class PreemptionHandler:
+    """Context manager: SIGTERM/SIGINT set a flag; the loop polls it.
+
+    The first signal requests a graceful stop. A second signal restores
+    the previous dispositions, so a third delivery gets the default
+    (fatal) behavior — an operator mashing Ctrl-C is not held hostage by
+    graceful shutdown.
+
+    ``poll(step)`` is the step-boundary check. Single-process it is a
+    plain attribute read (zero overhead — safe to call every step).
+    Multi-host it all-reduces the flag across processes every
+    ``check_every`` steps (a host collective via
+    ``parallel.multihost.allreduce_sum_across_hosts``), returning True
+    on the same step for every process; off-cadence steps return False
+    without communicating.
+    """
+
+    def __init__(
+        self,
+        signals: Sequence[int] = (signal.SIGTERM, signal.SIGINT),
+        check_every: int = 16,
+    ):
+        self.signals = tuple(signals)
+        self.check_every = max(1, int(check_every))
+        self._requested = False
+        self._previous: dict = {}
+
+    @property
+    def requested(self) -> bool:
+        """This process's local flag (pre-agreement)."""
+        return self._requested
+
+    def _handle(self, signum, frame) -> None:
+        if self._requested:
+            # Second signal: stop intercepting so the next one is fatal.
+            self._restore()
+            return
+        self._requested = True
+        # stderr, not stdout: child stdout is a parsed protocol stream in
+        # the test/bench harnesses around the trainer.
+        print(
+            f"preemption: received signal {signum}; will checkpoint and "
+            "exit at the next step boundary",
+            file=sys.stderr,
+        )
+
+    def __enter__(self) -> "PreemptionHandler":
+        for s in self.signals:
+            self._previous[s] = signal.signal(s, self._handle)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._restore()
+
+    def _restore(self) -> None:
+        for s, prev in self._previous.items():
+            try:
+                signal.signal(s, prev)
+            except (OSError, ValueError) as e:  # non-main thread / teardown
+                print(f"preemption: could not restore signal {s}: {e}",
+                      file=sys.stderr)
+        self._previous = {}
+
+    def poll(self, step: int) -> bool:
+        """Agreed should-we-stop decision at step boundary ``step``."""
+        from raft_ncup_tpu.parallel.multihost import (
+            allreduce_sum_across_hosts,
+            is_multihost,
+        )
+
+        if not is_multihost():
+            return self._requested
+        if step % self.check_every:
+            return False
+        import numpy as np
+
+        flag = np.asarray(int(self._requested), np.int32)
+        return bool(allreduce_sum_across_hosts(flag) > 0)

@@ -1,0 +1,107 @@
+"""Bounded exponential-backoff retry for host-side IO.
+
+The failure class this covers is *transient host IO*: an NFS hiccup mid
+``dataset.sample``, a filesystem stall under an orbax save. Those must
+not kill a 100k-step run — but an unbounded retry loop must not hang it
+either, and a retry that silently absorbs faults is its own bug (JGL007
+exists for exactly that). So every retry here is **bounded**, **backs
+off exponentially**, and **accounts**: callers hand in a
+:class:`RetryStats` whose totals the train driver writes to log.txt at
+run end, so a run that survived on retries says so.
+
+Pure stdlib — no jax import: retry wraps host IO only, never device
+work (a failed collective is not retryable; it needs the preemption
+path).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Tuple, TypeVar
+
+T = TypeVar("T")
+
+
+@dataclass(eq=False)  # a counter object: identity, not value, equality
+class RetryStats:
+    """Per-run IO-fault accounting, rendered into log.txt.
+
+    Thread-safe: loader pool workers fail concurrently, and accounting
+    that undercounts under exactly the concurrent-failure load it exists
+    for would defeat its purpose. Mutate through the ``note_*`` /
+    ``quarantine`` methods, not the fields."""
+
+    retries: int = 0  # failed attempts that were retried
+    giveups: int = 0  # operations that exhausted their attempt budget
+    quarantined: list = field(default_factory=list)  # poisoned sample indices
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def note_retry(self) -> None:
+        with self._lock:
+            self.retries += 1
+
+    def note_giveup(self) -> None:
+        with self._lock:
+            self.giveups += 1
+
+    def quarantine(self, index: int) -> bool:
+        """Record a quarantined index once; False if already recorded."""
+        with self._lock:
+            if index in self.quarantined:
+                return False
+            self.quarantined.append(index)
+            return True
+
+    @property
+    def clean(self) -> bool:
+        return not (self.retries or self.giveups or self.quarantined)
+
+    def summary(self) -> str:
+        q = ",".join(str(i) for i in self.quarantined) or "-"
+        return (
+            f"retries={self.retries} giveups={self.giveups} "
+            f"quarantined=[{q}]"
+        )
+
+
+def retry_io(
+    fn: Callable[[], T],
+    *,
+    attempts: int = 3,
+    base_delay_s: float = 0.05,
+    max_delay_s: float = 2.0,
+    retry_on: Tuple[type, ...] = (OSError,),
+    stats: Optional[RetryStats] = None,
+    desc: str = "io",
+    sleep: Callable[[float], None] = time.sleep,
+    log: Optional[Callable[[str], None]] = None,
+) -> T:
+    """Call ``fn`` with up to ``attempts`` retries on ``retry_on``.
+
+    The first call plus ``attempts`` retries; delays double from
+    ``base_delay_s`` up to ``max_delay_s``. The final failure re-raises
+    the original exception (after counting a giveup) — this helper never
+    swallows. ``sleep`` is injectable so tests run on a fake clock.
+    """
+    delay = base_delay_s
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt >= attempts:
+                if stats is not None:
+                    stats.note_giveup()
+                raise
+            attempt += 1
+            if stats is not None:
+                stats.note_retry()
+            if log is not None:
+                log(
+                    f"{desc}: attempt {attempt}/{attempts} failed ({e}); "
+                    f"retrying in {delay:.2f}s"
+                )
+            sleep(delay)
+            delay = min(delay * 2.0, max_delay_s)

@@ -19,27 +19,60 @@ Three load paths mirror the reference's semantics:
 
 from __future__ import annotations
 
+import json
 import os
+import sys
 from typing import Optional
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+try:
+    from orbax.checkpoint.checkpoint_manager import StepAlreadyExistsError
+except ImportError:  # pragma: no cover - orbax layout drift
+    class StepAlreadyExistsError(ValueError):
+        """Stand-in for orbax builds that don't export the type; never
+        raised, so the idempotent-save catch simply never fires."""
+
+from raft_ncup_tpu.resilience.anomaly import init_sentinel
+from raft_ncup_tpu.resilience.retry import RetryStats, retry_io
 from raft_ncup_tpu.training.state import TrainState
 from raft_ncup_tpu.utils.torch_import import load_torch_checkpoint
 
+METADATA_FILE = "resume_meta.json"
+
 
 class CheckpointManager:
-    """Thin orbax CheckpointManager wrapper bound to a run directory."""
+    """Thin orbax CheckpointManager wrapper bound to a run directory.
 
-    def __init__(self, directory: str, max_to_keep: int = 5):
+    ``metadata`` (resilience/preemption.py's ``resume_metadata`` blob:
+    model variant, config fingerprint, seed) is written next to the
+    orbax payloads on every save and VERIFIED before every restore — a
+    wrong-architecture resume fails with a clear message instead of an
+    opaque orbax pytree-structure error. ``save`` is synchronous
+    (staging AND commit-wait) and idempotent per step, so the whole
+    write retries on transient ``OSError`` with bounded backoff
+    (``retry_stats`` accounts; the train driver writes it to log.txt).
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 5,
+        metadata: Optional[dict] = None,
+        save_retries: int = 2,
+    ):
+        self._dir = os.path.abspath(directory)
         self._mgr = ocp.CheckpointManager(
-            os.path.abspath(directory),
+            self._dir,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True
             ),
         )
+        self._metadata = dict(metadata) if metadata else None
+        self._save_retries = save_retries
+        self.retry_stats = RetryStats()
 
     def save(self, state: TrainState, step: Optional[int] = None) -> None:
         step = int(state.step) if step is None else int(step)
@@ -48,21 +81,129 @@ class CheckpointManager:
             "params": state.params,
             "batch_stats": state.batch_stats,
             "opt_state": state.opt_state,
+            # Always present so the payload structure is uniform whether
+            # or not the sentinel is enabled (zeros when it is off).
+            "sentinel": (
+                state.sentinel if state.sentinel is not None
+                else init_sentinel()
+            ),
         }
-        self._mgr.save(step, args=ocp.args.StandardSave(payload))
+        def _save_and_commit() -> None:
+            # orbax defaults to ASYNC checkpointing: save() returns after
+            # staging and the disk write fails (if it fails) inside
+            # wait_until_finished. Retrying the staging call alone would
+            # never cover the actual write, so the retried unit is
+            # save + commit-wait. A retry after an attempt that actually
+            # committed (the error raced the commit) surfaces as
+            # step-already-exists — that is success, not a failure, which
+            # makes save() idempotent per step.
+            try:
+                self._mgr.save(step, args=ocp.args.StandardSave(payload))
+            except StepAlreadyExistsError:
+                return
+            self._mgr.wait_until_finished()
+
+        retry_io(
+            _save_and_commit,
+            attempts=self._save_retries,
+            base_delay_s=0.2,
+            stats=self.retry_stats,
+            desc=f"checkpoint save @{step}",
+            log=self._log_retry,
+        )
+        self._write_metadata()
+
+    @staticmethod
+    def _log_retry(msg: str) -> None:
+        # stderr: child stdout is a parsed protocol stream in the bench
+        # and distributed-test harnesses around the trainer.
+        print(f"CheckpointManager {msg}", file=sys.stderr)
+
+    def _write_metadata(self) -> None:
+        if self._metadata is None or jax.process_index() != 0:
+            return
+        path = os.path.join(self._dir, METADATA_FILE)
+
+        def _write() -> None:
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(self._metadata, f, indent=2, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, path)  # atomic publish
+
+        retry_io(
+            _write,
+            attempts=self._save_retries,
+            base_delay_s=0.2,
+            stats=self.retry_stats,
+            desc="resume-metadata write",
+            log=self._log_retry,
+        )
+
+    def saved_metadata(self) -> Optional[dict]:
+        """The resume-metadata blob recorded in the run directory, or
+        None for pre-metadata checkpoints."""
+        path = os.path.join(self._dir, METADATA_FILE)
+        if not os.path.exists(path):
+            return None
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+
+    def verify_metadata(self) -> None:
+        """Fail fast — and legibly — on a mismatched resume."""
+        if self._metadata is None:
+            return
+        saved = self.saved_metadata()
+        if saved is None:
+            return  # nothing recorded: nothing to verify against
+        mismatch = {
+            k: (saved[k], v)
+            for k, v in self._metadata.items()
+            if k in saved and saved[k] != v
+        }
+        if mismatch:
+            detail = "; ".join(
+                f"{k}: checkpoint has {a!r}, this run expects {b!r}"
+                for k, (a, b) in sorted(mismatch.items())
+            )
+            raise ValueError(
+                f"refusing to restore from {self._dir}: resume metadata "
+                f"mismatch ({detail}). A mismatched architecture/config "
+                "would otherwise die deep inside orbax with an opaque "
+                "pytree-structure error — fix --model / --restore_ckpt "
+                "(or the seed) to match the checkpointed run."
+            )
 
     def wait(self) -> None:
+        """Compatibility barrier: ``save`` already commits synchronously
+        (the retried unit is staging + wait), so this is a no-op unless
+        a future orbax path re-introduces background work."""
         self._mgr.wait_until_finished()
 
     @property
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def _payload_has_sentinel(self, step: int) -> bool:
+        """Whether the saved payload carries the 'sentinel' subtree.
+        Pre-resilience checkpoints don't; restoring them with a sentinel
+        in the target would die on the orbax structure mismatch this
+        class otherwise exists to make legible. Read from the step's
+        on-disk tree metadata; unknown layouts assume current-format."""
+        path = os.path.join(self._dir, str(step), "default", "_METADATA")
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = json.load(f).get("tree_metadata", {})
+        except (OSError, ValueError):
+            return True
+        return any(k.startswith("('sentinel'") for k in tree)
+
     def restore(
         self, state: TrainState, step: Optional[int] = None
     ) -> TrainState:
         """Restore into the structure of ``state`` (which supplies the
         optimizer transform and pytree shapes)."""
+        self.verify_metadata()
         step = self._mgr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError("no checkpoint found")
@@ -72,6 +213,12 @@ class CheckpointManager:
             "batch_stats": state.batch_stats,
             "opt_state": state.opt_state,
         }
+        has_sentinel = self._payload_has_sentinel(step)
+        if has_sentinel:
+            target["sentinel"] = (
+                state.sentinel if state.sentinel is not None
+                else init_sentinel()
+            )
         restored = self._mgr.restore(
             step, args=ocp.args.StandardRestore(target)
         )
@@ -80,6 +227,13 @@ class CheckpointManager:
             params=restored["params"],
             batch_stats=restored["batch_stats"],
             opt_state=restored["opt_state"],
+            # A pre-sentinel payload restores with the run's fresh
+            # (zeroed) counters; disabled-sentinel runs stay None.
+            sentinel=(
+                restored["sentinel"]
+                if has_sentinel and state.sentinel is not None
+                else state.sentinel
+            ),
         )
 
     def close(self) -> None:
@@ -120,11 +274,19 @@ def restore_variables(directory: str) -> dict:
     orbax run directory's latest step — the eval-side restore (no
     optimizer state, no TrainState structure needed)."""
     mgr = ocp.CheckpointManager(os.path.abspath(directory))
-    step = mgr.latest_step()
-    if step is None:
-        raise FileNotFoundError(f"no checkpoint in {directory}")
-    restored = mgr.restore(step)
-    mgr.close()
+    try:
+        step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {directory}")
+        # Explicit StandardRestore: this orbax build cannot infer the
+        # handler for a bare restore(step) and raises an opaque
+        # 'Item "default" ... could not be restored' KeyError.
+        restored = mgr.restore(step, args=ocp.args.StandardRestore())
+    finally:
+        # The orbax manager owns background threads and an async-save
+        # barrier; leaking it on a failed restore (missing/corrupt
+        # checkpoint) kept those alive for the life of the process.
+        mgr.close()
     out = {"params": restored["params"]}
     if restored.get("batch_stats"):
         out["batch_stats"] = restored["batch_stats"]

@@ -71,6 +71,29 @@ def build_schedule(cfg: TrainConfig):
     raise NotImplementedError(f"{cfg.scheduler} scheduler is not implemented!")
 
 
+# Transform reuse across trainer invocations in one process. optax
+# transforms are stateless function bundles, so sharing one instance
+# between TrainStates is sound — and necessary for executable reuse:
+# ``tx`` rides the TrainState treedef as static metadata
+# (pytree_node=False), so a fresh ``tx`` per run means a fresh treedef
+# and a full XLA recompile of an otherwise identical train step. The
+# kill/resume path (resilience tests, notebook restarts) invokes the
+# trainer repeatedly in one process and would otherwise pay that
+# compile every time. Keyed on exactly the config fields the transform
+# reads; the freeze_raft mask path is excluded (pytree masks are not
+# hashable and the flagship path never uses it repeatedly). Bounded FIFO
+# so config sweeps cannot grow it without limit.
+_TX_CACHE: dict = {}
+_TX_CACHE_MAX = 16
+
+
+def _tx_cache_key(cfg: TrainConfig) -> tuple:
+    return (
+        cfg.optimizer.lower(), cfg.lr, cfg.wdecay, cfg.epsilon, cfg.clip,
+        cfg.scheduler.lower(), cfg.scheduler_step, cfg.total_schedule_steps,
+    )
+
+
 def build_optimizer(
     cfg: TrainConfig,
     trainable_mask: Optional[dict] = None,
@@ -81,6 +104,11 @@ def build_optimizer(
       trainable_mask: params-shaped pytree of bools; False freezes the
         parameter (used for freeze_raft).
     """
+    if trainable_mask is None:
+        key = _tx_cache_key(cfg)
+        cached = _TX_CACHE.get(key)
+        if cached is not None:
+            return cached
     schedule = build_schedule(cfg)
     if cfg.optimizer.lower() == "adamw":
         opt = optax.adamw(
@@ -105,9 +133,12 @@ def build_optimizer(
         labels = jax.tree.map(
             lambda m: "train" if m else "frozen", trainable_mask
         )
-        tx = optax.multi_transform(
+        return optax.multi_transform(
             {"train": tx, "frozen": optax.set_to_zero()}, labels
         )
+    while len(_TX_CACHE) >= _TX_CACHE_MAX:
+        _TX_CACHE.pop(next(iter(_TX_CACHE)))
+    _TX_CACHE[key] = tx
     return tx
 
 

@@ -25,6 +25,11 @@ class TrainState(struct.PyTreeNode):
     batch_stats: Any
     opt_state: Any
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    # Divergence-sentinel accumulators (resilience/anomaly.py), carried in
+    # the state pytree so they live on device and ride the same donated
+    # buffers as the optimizer state. None when the sentinel is disabled
+    # (an empty pytree subtree — invisible to tree ops and shardings).
+    sentinel: Any = None
 
     def apply_gradients(self, grads, new_batch_stats=None):
         updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
@@ -61,11 +66,18 @@ def create_train_state(
     tx = build_optimizer(train_cfg, trainable_mask=mask)
     opt_state = tx.init(params)
 
+    sentinel = None
+    if getattr(train_cfg, "anomaly_sentinel", False):
+        from raft_ncup_tpu.resilience.anomaly import init_sentinel
+
+        sentinel = init_sentinel()
+
     state = TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,
         batch_stats=batch_stats,
         opt_state=opt_state,
         tx=tx,
+        sentinel=sentinel,
     )
     return model, state

@@ -393,6 +393,81 @@ def test_jgl006_silent_without_declaration(tmp_path):
     assert findings == []
 
 
+# --------------------------------------------------------------- JGL007
+
+
+def test_jgl007_flags_swallowed_exceptions(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        def load(path):
+            try:
+                return open(path).read()
+            except Exception:
+                pass
+
+        def drain(q):
+            while True:
+                try:
+                    return q.get_nowait()
+                except:
+                    continue
+        """,
+        name="data/bad.py",
+    )
+    assert [f.rule for f in findings] == ["JGL007"] * 2
+    assert {f.qualname for f in findings} == {"load", "drain"}
+
+
+def test_jgl007_negative_handled_or_narrow(tmp_path):
+    """Re-raised, logged/accounted, or narrow handlers are all fine —
+    the rule only hunts silent broad swallows."""
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import sys
+
+        def save(fn):
+            try:
+                fn()
+            except Exception as e:
+                print(f"save failed: {e}", file=sys.stderr)
+                raise
+
+        def close(handle):
+            try:
+                handle.close()
+            except OSError:
+                pass  # narrow: an expected, decided-on drop
+
+        def teardown(handle, stats):
+            try:
+                handle.close()
+            except Exception as e:
+                stats.record(e)  # accounted
+        """,
+        name="training/ok.py",
+    )
+    assert findings == []
+
+
+def test_jgl007_out_of_scope_paths_exempt(tmp_path):
+    """The same swallow outside resilience//training//data/ is not this
+    rule's business (drivers and analysis code have their own idioms)."""
+    findings = lint_snippet(
+        tmp_path,
+        """
+        def f(x):
+            try:
+                return x()
+            except Exception:
+                pass
+        """,
+        name="drivers/free.py",
+    )
+    assert findings == []
+
+
 # ------------------------------------------------------------- allowlist
 
 

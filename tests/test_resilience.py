@@ -1,0 +1,566 @@
+"""Unit tests for raft_ncup_tpu/resilience/: retry + quarantine, the
+divergence sentinel (pure and folded into the real jitted step), chaos
+primitives, preemption handler, and the checkpoint metadata / leak-fix
+satellites. End-to-end chaos runs through train.main live in
+tests/test_chaos_train.py."""
+
+from __future__ import annotations
+
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from raft_ncup_tpu.config import TrainConfig, small_model_config
+from raft_ncup_tpu.resilience import (
+    ChaosDataset,
+    ChaosSpec,
+    PreemptionHandler,
+    RetryStats,
+    chaos_batches,
+    guard_update,
+    init_sentinel,
+    resume_metadata,
+    retry_io,
+)
+from raft_ncup_tpu.training.state import TrainState
+
+
+# ------------------------------------------------------------------ retry
+
+
+class TestRetryIO:
+    def test_backoff_then_success(self):
+        calls, delays = [], []
+        stats = RetryStats()
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        out = retry_io(
+            flaky, attempts=3, base_delay_s=0.05, stats=stats,
+            sleep=delays.append,
+        )
+        assert out == "ok"
+        assert stats.retries == 2 and stats.giveups == 0
+        assert delays == [0.05, 0.1]  # exponential
+
+    def test_bounded_giveup_reraises_original(self):
+        stats = RetryStats()
+
+        def doomed():
+            raise OSError("disk on fire")
+
+        with pytest.raises(OSError, match="disk on fire"):
+            retry_io(doomed, attempts=2, stats=stats, sleep=lambda _: None)
+        assert stats.retries == 2 and stats.giveups == 1
+        assert not stats.clean
+
+    def test_non_retryable_exception_passes_through(self):
+        def typo():
+            raise ValueError("not IO")
+
+        with pytest.raises(ValueError):
+            retry_io(typo, attempts=5, sleep=lambda _: None)
+
+    def test_delay_caps_at_max(self):
+        delays = []
+
+        def doomed():
+            raise OSError("x")
+
+        with pytest.raises(OSError):
+            retry_io(
+                doomed, attempts=6, base_delay_s=0.5, max_delay_s=1.0,
+                sleep=delays.append,
+            )
+        assert delays == [0.5, 1.0, 1.0, 1.0, 1.0, 1.0]
+
+
+# ------------------------------------------------------------------ chaos
+
+
+class TestChaosSpec:
+    def test_parse_roundtrip(self):
+        spec = ChaosSpec.parse("nan@3,nan@7, ioerror@2,sigterm@5")
+        assert spec.nan_steps == frozenset({3, 7})
+        assert spec.ioerror_reads == frozenset({2})
+        assert spec.sigterm_after == 5
+        assert spec.active
+        assert spec.render() == "nan@3,nan@7,ioerror@2,sigterm@5"
+
+    def test_empty_spec_inactive(self):
+        assert not ChaosSpec.parse(None).active
+        assert not ChaosSpec.parse("").active
+
+    def test_bad_tokens_raise(self):
+        with pytest.raises(ValueError, match="bad chaos event"):
+            ChaosSpec.parse("explode@3")
+        with pytest.raises(ValueError):
+            ChaosSpec.parse("nan7")
+
+
+def test_chaos_batches_poisons_exactly_the_configured_step():
+    batches = [
+        {"flow": np.zeros((1, 2, 2, 2), np.float32), "valid": np.ones(1)}
+        for _ in range(4)
+    ]
+    out = list(chaos_batches(iter(batches), frozenset({6}), start_step=5))
+    assert len(out) == 4
+    # Stream position 1 == step 6: poisoned, copy-on-write.
+    assert np.isnan(out[1]["flow"]).all()
+    assert not np.isnan(batches[1]["flow"]).any()  # original untouched
+    for i in (0, 2, 3):
+        assert out[i] is batches[i]  # pass-through, no copies
+
+
+class _StubDataset:
+    """6 samples; flow encodes the index so substitution is observable."""
+
+    def __init__(self, poisoned=()):
+        self.poisoned = set(poisoned)
+        self.is_test = False
+
+    def __len__(self):
+        return 6
+
+    def sample(self, index, rng=None):
+        if index in self.poisoned:
+            raise OSError(f"unreadable sample {index}")
+        return {
+            "image1": np.zeros((4, 4, 3), np.uint8),
+            "image2": np.zeros((4, 4, 3), np.uint8),
+            "flow": np.full((4, 4, 2), float(index), np.float32),
+            "valid": np.ones((4, 4), np.float32),
+        }
+
+
+def test_chaos_dataset_injects_ioerror_on_nth_read():
+    ds = ChaosDataset(_StubDataset(), frozenset({1}))
+    assert len(ds) == 6
+    assert ds.is_test is False  # attribute pass-through
+    ds.sample(0)  # read 0: fine
+    with pytest.raises(IOError, match="injected IOError on dataset read 1"):
+        ds.sample(0)  # read 1: injected
+    ds.sample(0)  # read 2: fine again — count-based, deterministic
+
+
+# ------------------------------------------------- loader retry/quarantine
+
+
+def test_flow_loader_retries_transient_and_quarantines_poison():
+    from raft_ncup_tpu.data.loader import FlowLoader
+
+    # Index 2 is permanently poisoned; everything else reads fine.
+    loader = FlowLoader(
+        _StubDataset(poisoned={2}),
+        batch_size=2,
+        shuffle=False,
+        num_workers=1,
+        shard_index=0,
+        num_shards=1,
+        io_retries=2,
+        io_retry_backoff_s=0.0,
+    )
+    batches = loader.batches(start_epoch=0, start_batch=0)
+    first_epoch = [next(batches) for _ in range(3)]  # 6 samples / 2
+    second_epoch = [next(batches) for _ in range(3)]
+    batches.close()
+
+    # Batches keep their shape; index 2 was substituted by index 3.
+    flows = sorted(
+        float(b["flow"][i, 0, 0, 0])
+        for b in first_epoch
+        for i in range(2)
+    )
+    assert flows == [0.0, 1.0, 3.0, 3.0, 4.0, 5.0]
+    # Accounting: io_retries failed attempts, then quarantine.
+    assert loader.retry_stats.retries == 2
+    assert loader.retry_stats.quarantined == [2]
+    assert not loader.retry_stats.clean
+    # Second epoch: the quarantined index short-circuits to the
+    # substitute without burning retries again.
+    assert loader.retry_stats.retries == 2
+    flows2 = sorted(
+        float(b["flow"][i, 0, 0, 0])
+        for b in second_epoch
+        for i in range(2)
+    )
+    assert flows2 == [0.0, 1.0, 3.0, 3.0, 4.0, 5.0]
+
+
+def test_flow_loader_substitute_read_also_retries_and_quarantines():
+    """The substitute path is covered by the same retry/quarantine
+    policy: a poisoned substitute is quarantined too and the next
+    candidate is used — a flaky stand-in must not kill the run the
+    quarantine exists to protect."""
+    from raft_ncup_tpu.data.loader import FlowLoader
+
+    loader = FlowLoader(
+        _StubDataset(poisoned={2, 3}),
+        batch_size=2,
+        shuffle=False,
+        num_workers=1,
+        shard_index=0,
+        num_shards=1,
+        io_retries=1,
+        io_retry_backoff_s=0.0,
+    )
+    batches = loader.batches(start_epoch=0, start_batch=0)
+    epoch = [next(batches) for _ in range(3)]
+    batches.close()
+    flows = sorted(
+        float(b["flow"][i, 0, 0, 0]) for b in epoch for i in range(2)
+    )
+    # Indices 2 AND 3 both land on substitute 4.
+    assert flows == [0.0, 1.0, 4.0, 4.0, 4.0, 5.0]
+    assert sorted(loader.retry_stats.quarantined) == [2, 3]
+
+
+def test_flow_loader_substitute_stays_inside_host_shard():
+    """On a sharded loader, a quarantined sample's stand-in must come
+    from THIS host's shard — an index another host also serves would let
+    a multihost global batch double-load a sample."""
+    from raft_ncup_tpu.data.loader import FlowLoader
+
+    loader = FlowLoader(
+        _StubDataset(poisoned={2}),
+        batch_size=1,
+        shuffle=False,
+        num_workers=1,
+        shard_index=0,
+        num_shards=2,  # this host owns indices 0, 2, 4
+        io_retries=0,
+        io_retry_backoff_s=0.0,
+    )
+    batches = loader.batches(start_epoch=0, start_batch=0)
+    flows = [float(next(batches)["flow"][0, 0, 0, 0]) for _ in range(3)]
+    batches.close()
+    # Index 2 substitutes with 4 (the shard's next index), NOT 3
+    # (host 1's sample).
+    assert flows == [0.0, 4.0, 4.0]
+    assert loader.retry_stats.quarantined == [2]
+
+
+def test_flow_loader_all_quarantined_raises_clearly():
+    """Every sample unreadable = the data source is gone, not flaky:
+    the loader must surface a clear error, not spin forever."""
+    from raft_ncup_tpu.data.loader import FlowLoader
+
+    loader = FlowLoader(
+        _StubDataset(poisoned={0, 1, 2, 3, 4, 5}),
+        batch_size=2,
+        shuffle=False,
+        num_workers=1,
+        shard_index=0,
+        num_shards=1,
+        io_retries=0,
+        io_retry_backoff_s=0.0,
+    )
+    batches = loader.batches(start_epoch=0, start_batch=0)
+    with pytest.raises(RuntimeError, match="quarantined"):
+        next(batches)
+    batches.close()
+
+
+# --------------------------------------------------------------- sentinel
+
+
+def _tiny_state() -> TrainState:
+    params = {"w": jnp.ones((3,), jnp.float32)}
+    tx = optax.sgd(0.1)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats={},
+        opt_state=tx.init(params),
+        tx=tx,
+        sentinel=init_sentinel(),
+    )
+
+
+_CFG = TrainConfig(
+    anomaly_sentinel=True, sentinel_spike_factor=20.0,
+    sentinel_ema_decay=0.99, sentinel_warmup=2, sentinel_halt_after=3,
+)
+
+
+class TestGuardUpdate:
+    def test_nonfinite_step_is_skipped_bitwise(self):
+        state = _tiny_state()
+        new = state.apply_gradients({"w": jnp.full((3,), jnp.nan)})
+        guarded, m = guard_update(
+            state, new, jnp.float32(jnp.nan), jnp.float32(jnp.nan), _CFG
+        )
+        np.testing.assert_array_equal(
+            np.asarray(guarded.params["w"]), np.ones(3, np.float32)
+        )
+        sen = jax.device_get(guarded.sentinel)
+        assert int(sen["skipped"]) == 1 and int(sen["consecutive"]) == 1
+        assert float(m["bad_step"]) == 1.0
+        # Attempted-step counter still advances (data-stream position).
+        assert int(guarded.step) == 1
+
+    def test_good_step_passes_through_bitwise(self):
+        state = _tiny_state()
+        new = state.apply_gradients({"w": jnp.full((3,), 0.5)})
+        guarded, m = guard_update(
+            state, new, jnp.float32(1.0), jnp.float32(0.5), _CFG
+        )
+        np.testing.assert_array_equal(
+            np.asarray(guarded.params["w"]), np.asarray(new.params["w"])
+        )
+        sen = jax.device_get(guarded.sentinel)
+        assert int(sen["skipped"]) == 0 and int(sen["good"]) == 1
+        assert float(sen["ema_grad_norm"]) == 0.5  # first good step seeds
+        assert float(m["bad_step"]) == 0.0
+
+    def test_grad_norm_spike_is_skipped_after_warmup(self):
+        state = _tiny_state()
+        # Warm the EMA: sentinel_warmup good steps at grad_norm 1.0.
+        for _ in range(_CFG.sentinel_warmup):
+            new = state.apply_gradients({"w": jnp.full((3,), 0.01)})
+            state, _ = guard_update(
+                state, new, jnp.float32(1.0), jnp.float32(1.0), _CFG
+            )
+        before = np.asarray(state.params["w"]).copy()
+        new = state.apply_gradients({"w": jnp.full((3,), 5.0)})
+        state, m = guard_update(
+            state, new, jnp.float32(1.0), jnp.float32(1000.0), _CFG
+        )
+        assert float(m["bad_step"]) == 1.0
+        np.testing.assert_array_equal(np.asarray(state.params["w"]), before)
+        # A merely-large (not spiking) step passes.
+        new = state.apply_gradients({"w": jnp.full((3,), 0.01)})
+        state, m = guard_update(
+            state, new, jnp.float32(1.0), jnp.float32(5.0), _CFG
+        )
+        assert float(m["bad_step"]) == 0.0
+
+    def test_consecutive_counts_and_resets(self):
+        state = _tiny_state()
+        nan = jnp.float32(jnp.nan)
+        for expect in (1, 2):
+            new = state.apply_gradients({"w": jnp.full((3,), jnp.nan)})
+            state, _ = guard_update(state, new, nan, nan, _CFG)
+            assert int(jax.device_get(state.sentinel["consecutive"])) == expect
+        new = state.apply_gradients({"w": jnp.full((3,), 0.1)})
+        state, _ = guard_update(
+            state, new, jnp.float32(1.0), jnp.float32(1.0), _CFG
+        )
+        sen = jax.device_get(state.sentinel)
+        assert int(sen["consecutive"]) == 0 and int(sen["skipped"]) == 2
+
+
+def test_sentinel_in_real_jitted_step_skips_nan_batch():
+    """The sentinel folded into make_train_step, against the real small
+    model: a NaN batch leaves params AND optimizer moments bitwise
+    unchanged, the run continues, and the next good step trains."""
+    from raft_ncup_tpu.parallel.step import make_train_step
+    from raft_ncup_tpu.training.state import create_train_state
+
+    B, H, W = 2, 16, 24
+    mcfg = small_model_config(variant="raft")
+    tcfg = TrainConfig(
+        stage="chairs", lr=1e-4, num_steps=50, batch_size=B,
+        image_size=(H, W), iters=2, anomaly_sentinel=True,
+    )
+    model, state = create_train_state(jax.random.key(0), mcfg, tcfg)
+    assert state.sentinel is not None
+    step = make_train_step(model, tcfg)
+    g = np.random.default_rng(0)
+
+    def batch(nan=False):
+        flow = g.standard_normal((B, H, W, 2)).astype(np.float32)
+        if nan:
+            flow[...] = np.nan
+        return {
+            "image1": g.uniform(0, 255, (B, H, W, 3)).astype(np.float32),
+            "image2": g.uniform(0, 255, (B, H, W, 3)).astype(np.float32),
+            "flow": flow,
+            "valid": np.ones((B, H, W), np.float32),
+        }
+
+    state, m = step(state, batch(), jax.random.key(1))
+    assert float(m["bad_step"]) == 0.0
+    params_snap = [np.array(x) for x in jax.tree.leaves(state.params)]
+    opt_snap = [np.array(x) for x in jax.tree.leaves(state.opt_state)]
+
+    state, m = step(state, batch(nan=True), jax.random.key(2))
+    assert float(m["bad_step"]) == 1.0
+    for a, b in zip(params_snap, jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    for a, b in zip(opt_snap, jax.tree.leaves(state.opt_state)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    sen = jax.device_get(state.sentinel)
+    assert int(sen["skipped"]) == 1 and int(sen["consecutive"]) == 1
+    assert int(state.step) == 2  # attempted steps keep counting
+
+    state, m = step(state, batch(), jax.random.key(3))
+    assert float(m["bad_step"]) == 0.0
+    changed = any(
+        not np.array_equal(a, np.asarray(b))
+        for a, b in zip(params_snap, jax.tree.leaves(state.params))
+    )
+    assert changed  # the good step trained
+    assert int(jax.device_get(state.sentinel["consecutive"])) == 0
+
+
+# ------------------------------------------------------------- preemption
+
+
+def test_preemption_handler_flag_poll_and_restore():
+    prev = signal.getsignal(signal.SIGTERM)
+    with PreemptionHandler() as h:
+        assert not h.poll(0)
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert h.requested
+        assert h.poll(17)  # single-process: any step boundary sees it
+    assert signal.getsignal(signal.SIGTERM) is prev  # restored on exit
+
+
+def test_resume_metadata_fields():
+    meta = resume_metadata(
+        small_model_config("raft", dataset="chairs"),
+        TrainConfig(seed=77),
+    )
+    assert meta["model_variant"] == "raft"
+    assert meta["seed"] == 77
+    assert len(meta["config_fingerprint"]) == 16
+    # Any model-config change moves the fingerprint.
+    other = resume_metadata(
+        small_model_config("raft", dataset="sintel"), TrainConfig(seed=77)
+    )
+    assert other["config_fingerprint"] != meta["config_fingerprint"]
+
+
+# --------------------------------------------- checkpoint metadata + leak
+
+
+class TestCheckpointMetadata:
+    def test_mismatch_fails_with_clear_message(self, tmp_path):
+        from raft_ncup_tpu.training.checkpoint import CheckpointManager
+
+        state = _tiny_state().replace(step=jnp.asarray(3, jnp.int32))
+        tcfg = TrainConfig(seed=1)
+        meta = resume_metadata(small_model_config("raft"), tcfg)
+        mgr = CheckpointManager(str(tmp_path / "run"), metadata=meta)
+        mgr.save(state)
+        mgr.wait()
+        assert mgr.saved_metadata() == meta
+        mgr.close()
+
+        wrong = resume_metadata(
+            small_model_config("raft_nc_dbl"), TrainConfig(seed=2)
+        )
+        mgr2 = CheckpointManager(str(tmp_path / "run"), metadata=wrong)
+        with pytest.raises(ValueError, match="resume metadata mismatch"):
+            mgr2.restore(state)
+        try:
+            mgr2.restore(state)
+        except ValueError as e:
+            msg = str(e)
+            assert "model_variant" in msg and "seed" in msg
+            assert "config_fingerprint" in msg
+        mgr2.close()
+
+        # Matching metadata restores fine (sentinel counters round-trip).
+        mgr3 = CheckpointManager(str(tmp_path / "run"), metadata=meta)
+        restored = mgr3.restore(state)
+        assert int(restored.step) == 3
+        mgr3.close()
+
+    def test_pre_sentinel_checkpoint_restores(self, tmp_path):
+        """A checkpoint written by the pre-resilience code (payload
+        without the 'sentinel' subtree) must still restore — into a
+        sentinel-enabled state with fresh zeroed counters — instead of
+        dying on an orbax structure mismatch."""
+        import orbax.checkpoint as ocp
+
+        from raft_ncup_tpu.training.checkpoint import CheckpointManager
+
+        state = _tiny_state()
+        old_payload = {
+            "step": np.asarray(4),
+            "params": state.params,
+            "batch_stats": state.batch_stats,
+            "opt_state": state.opt_state,
+        }
+        raw = ocp.CheckpointManager(
+            str(tmp_path / "old"),
+            options=ocp.CheckpointManagerOptions(create=True),
+        )
+        raw.save(4, args=ocp.args.StandardSave(old_payload))
+        raw.wait_until_finished()
+        raw.close()
+
+        mgr = CheckpointManager(str(tmp_path / "old"))
+        restored = mgr.restore(state)
+        mgr.close()
+        assert int(restored.step) == 4
+        sen = jax.device_get(restored.sentinel)
+        assert int(sen["skipped"]) == 0  # fresh counters, not garbage
+
+    def test_save_retries_transient_oserror(self, tmp_path, monkeypatch):
+        from raft_ncup_tpu.training.checkpoint import CheckpointManager
+
+        state = _tiny_state()
+        mgr = CheckpointManager(str(tmp_path / "run"))
+        real_save = mgr._mgr.save
+        attempts = []
+
+        def flaky_save(*a, **kw):
+            attempts.append(1)
+            if len(attempts) == 1:
+                raise OSError("transient fs stall")
+            return real_save(*a, **kw)
+
+        monkeypatch.setattr(mgr._mgr, "save", flaky_save)
+        mgr.save(state, step=1)
+        mgr.wait()
+        assert mgr.latest_step == 1
+        assert mgr.retry_stats.retries == 1
+        mgr.close()
+
+
+def test_restore_variables_closes_manager_on_failure(tmp_path, monkeypatch):
+    """Satellite fix: the orbax manager must not leak when restore (or
+    the empty-directory check) raises."""
+    import raft_ncup_tpu.training.checkpoint as ckpt_mod
+
+    closed = []
+
+    class FakeMgr:
+        def __init__(self, *a, **kw):
+            pass
+
+        def latest_step(self):
+            return 3
+
+        def restore(self, step, args=None):
+            raise RuntimeError("corrupt checkpoint")
+
+        def close(self):
+            closed.append("closed")
+
+    monkeypatch.setattr(ckpt_mod.ocp, "CheckpointManager", FakeMgr)
+    with pytest.raises(RuntimeError, match="corrupt"):
+        ckpt_mod.restore_variables(str(tmp_path))
+    assert closed == ["closed"]
+
+    class EmptyMgr(FakeMgr):
+        def latest_step(self):
+            return None
+
+    closed.clear()
+    monkeypatch.setattr(ckpt_mod.ocp, "CheckpointManager", EmptyMgr)
+    with pytest.raises(FileNotFoundError):
+        ckpt_mod.restore_variables(str(tmp_path))
+    assert closed == ["closed"]

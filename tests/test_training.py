@@ -120,6 +120,33 @@ def test_freeze_raft_mask_zeroes_trunk_updates():
     assert float(jnp.abs(upd["upsampler"]["b"]).sum()) > 0.0
 
 
+def test_train_step_and_tx_memoized_across_invocations():
+    """Repeated in-process trainer invocation (kill/resume tests,
+    notebook restarts) must reuse the jitted step AND the optimizer
+    transform — a fresh tx per run changes the TrainState treedef and
+    forces a full recompile of an identical program."""
+    from raft_ncup_tpu.models.raft import RAFT
+
+    cfg = small_model_config(variant="raft")
+    tcfg = TrainConfig(stage="chairs", batch_size=1, image_size=(16, 24))
+    s1 = make_train_step(RAFT(cfg), tcfg)
+    s2 = make_train_step(RAFT(cfg), tcfg)  # new instance, equal config
+    assert s1 is s2
+    assert build_optimizer(tcfg) is build_optimizer(tcfg)
+    # Different run name / restore path: same program, same cache entry.
+    assert make_train_step(RAFT(cfg), TrainConfig(
+        stage="chairs", batch_size=1, image_size=(16, 24),
+        name="other", restore_ckpt="/elsewhere",
+    )) is s1
+    # Anything the traced program reads busts the cache.
+    assert make_train_step(RAFT(cfg), TrainConfig(
+        stage="chairs", batch_size=1, image_size=(16, 24), iters=7,
+    )) is not s1
+    assert build_optimizer(
+        TrainConfig(stage="chairs", lr=9e-9)
+    ) is not build_optimizer(tcfg)
+
+
 def _synthetic_batch(rng, B, H, W):
     return {
         "image1": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)), jnp.float32),

@@ -9,6 +9,17 @@ prefetch (transfer overlapped with compute; metrics accumulate on device
 so the steady-state loop never syncs the host), and checkpoints carry
 the full train state (params + optimizer + step) via orbax.
 
+Fault tolerance (raft_ncup_tpu/resilience/; docs/RESILIENCE.md):
+
+- the divergence sentinel rides inside the jitted step (non-finite or
+  grad-spiking steps are skip-updates; K consecutive bad steps halt the
+  run, roll back to the last good checkpoint and exit EXIT_DIVERGED);
+- SIGTERM/SIGINT trigger one atomic, multihost-agreed checkpoint plus
+  exact-resume metadata, then a clean exit with EXIT_PREEMPTED;
+- dataset reads and checkpoint saves retry with bounded backoff, with
+  per-run accounting in log.txt;
+- ``--chaos`` injects deterministic faults for the resilience tests.
+
 Example (mirrors train_raft_nc_things.sh):
     python train.py --name raft_nc_things --model raft_nc_dbl \
         --stage things --num_steps 100000 --batch_size 6 \
@@ -19,13 +30,14 @@ from __future__ import annotations
 
 import contextlib
 import os
+import signal
 import sys
 
 import jax
 import numpy as np
 
 
-def main(argv=None) -> None:
+def main(argv=None) -> int:
     from raft_ncup_tpu.cli import parse_train
     from raft_ncup_tpu.data import DevicePrefetcher, FlowLoader, fetch_training_set
     from raft_ncup_tpu.evaluation import VALIDATORS
@@ -36,6 +48,15 @@ def main(argv=None) -> None:
         is_multihost,
     )
     from raft_ncup_tpu.parallel.step import make_train_step
+    from raft_ncup_tpu.resilience import (
+        EXIT_DIVERGED,
+        EXIT_PREEMPTED,
+        ChaosDataset,
+        ChaosSpec,
+        PreemptionHandler,
+        chaos_batches,
+        resume_metadata,
+    )
     from raft_ncup_tpu.training.checkpoint import (
         CheckpointManager,
         load_pretrained_trunk,
@@ -46,7 +67,19 @@ def main(argv=None) -> None:
 
     args, model_cfg, train_cfg, data_cfg = parse_train(argv)
     initialize_distributed()  # no-op off-pod; wires processes on a pod
+    if os.environ.get("RAFT_NCUP_COMPILATION_CACHE") == "1":
+        # Persistent XLA cache: kill/resume cycles hit warm executables
+        # (resume overhead = restore latency, not a recompile). Opt-in
+        # by env and OFF by default: on the CPU CI host, reloading cache
+        # entries for the fwd+bwd train program has produced glibc heap
+        # corruption in this jax build (both in-process re-enables and
+        # child reloads) — use on accelerator hosts, where the cache is
+        # the difference between seconds and minutes of resume.
+        from raft_ncup_tpu.utils.runtime import enable_compilation_cache
+
+        enable_compilation_cache()
     np.random.seed(train_cfg.seed)  # reference: train.py:345-346
+    chaos = ChaosSpec.parse(args.chaos)
 
     run_dir = os.path.join(train_cfg.checkpoint_dir, train_cfg.name)
     # One writer per pod: only process 0 owns log.txt/TensorBoard (orbax
@@ -58,6 +91,8 @@ def main(argv=None) -> None:
         run_dir, config=train_cfg, sum_freq=train_cfg.sum_freq,
         active=is_main_process(),
     )
+    if chaos.active:
+        logger.write_text(f"chaos: {chaos.render()}")
 
     # Device mesh: data-parallel over all chips unless told otherwise. The
     # per-step global batch must divide evenly over the data axis; when the
@@ -116,14 +151,25 @@ def main(argv=None) -> None:
         )
         logger.write_text(f"warm-started trunk from {train_cfg.load_pretrained}")
 
-    ckpt = CheckpointManager(run_dir, max_to_keep=5)
+    # Exact-resume metadata rides next to every orbax payload and is
+    # verified before any restore: a wrong-arch/seed resume fails with a
+    # clear message, not an orbax pytree error.
+    meta = resume_metadata(model_cfg, train_cfg)
+    ckpt = CheckpointManager(run_dir, max_to_keep=5, metadata=meta)
     if train_cfg.restore_ckpt:
+        same_dir = (
+            os.path.abspath(train_cfg.restore_ckpt) == os.path.abspath(run_dir)
+        )
         restore_mgr = (
             ckpt
-            if os.path.abspath(train_cfg.restore_ckpt) == os.path.abspath(run_dir)
-            else CheckpointManager(train_cfg.restore_ckpt)
+            if same_dir
+            else CheckpointManager(train_cfg.restore_ckpt, metadata=meta)
         )
-        state = restore_mgr.restore(state)
+        try:
+            state = restore_mgr.restore(state)
+        finally:
+            if restore_mgr is not ckpt:
+                restore_mgr.close()
         logger.write_text(
             f"restored step {int(state.step)} from {train_cfg.restore_ckpt}"
         )
@@ -131,6 +177,8 @@ def main(argv=None) -> None:
     dataset = fetch_training_set(
         train_cfg.stage, train_cfg.image_size, data_cfg
     )
+    if chaos.ioerror_reads:
+        dataset = ChaosDataset(dataset, chaos.ioerror_reads)
     # --batch_size is the GLOBAL batch (reference semantics); each host
     # loads its slice.
     n_proc = jax.process_count()
@@ -145,6 +193,8 @@ def main(argv=None) -> None:
         seed=train_cfg.seed,
         num_workers=data_cfg.num_workers,
         prefetch=data_cfg.prefetch,
+        io_retries=data_cfg.io_retries,
+        io_retry_backoff_s=data_cfg.io_retry_backoff_s,
     )
     logger.write_text(
         f"training with {len(dataset)} pairs "
@@ -185,6 +235,11 @@ def main(argv=None) -> None:
     batches = loader.batches(
         start_epoch=step_i // per_epoch, start_batch=step_i % per_epoch
     )
+    if chaos.nan_steps:
+        batches = chaos_batches(
+            batches, chaos.nan_steps, start_step=step_i,
+            log=logger.write_text,
+        )
     # Async input pipeline: a worker thread moves host batches onto device
     # (into the step's batch sharding) depth>=2 steps ahead, so in steady
     # state next() hands back an already-device-resident batch and the
@@ -208,13 +263,23 @@ def main(argv=None) -> None:
 
         step_guard = StepGuard()
         guard_scope = step_guard.scope
+    sentinel_on = train_cfg.anomaly_sentinel and state.sentinel is not None
     profiling = False
     profile_scope = contextlib.ExitStack()
     loop_scope = contextlib.ExitStack()
     if step_guard is not None:
         loop_scope.enter_context(step_guard)
+    # SIGTERM/SIGINT set a flag here; the loop polls it at the step
+    # boundary (multihost: agreed via a fixed-cadence all-reduce so every
+    # process saves the same step).
+    preempt = loop_scope.enter_context(PreemptionHandler())
+    status = 0
+    preempted = halted = False
     try:
         while step_i < total:
+            if preempt.poll(step_i):
+                preempted = True
+                break
             if args.profile_steps and step_i == start_step + 1:
                 # Skip the compile step, then trace a few hot steps.
                 from raft_ncup_tpu.utils.profiling import trace
@@ -231,6 +296,10 @@ def main(argv=None) -> None:
                 state, metrics = step_fn(state, device_batch, rng)
                 step_i += 1  # host-side counter; int(state.step) would sync
                 logger.push(step_i - 1, metrics, lr=schedule(step_i - 1))
+            if chaos.sigterm_after == step_i:
+                # Chaos harness: a REAL signal through the real handler,
+                # pinned to a step boundary so tests replay exactly.
+                os.kill(os.getpid(), signal.SIGTERM)
             if profiling and step_i >= start_step + 1 + args.profile_steps:
                 jax.block_until_ready(metrics["loss"])
                 profile_scope.close()
@@ -238,11 +307,57 @@ def main(argv=None) -> None:
                 logger.write_text(
                     f"profile trace written to {run_dir}/profile"
                 )
+            if sentinel_on and step_i % train_cfg.sum_freq == 0:
+                # The sentinel's ONLY host pull: window cadence, explicit
+                # sanctioned device_get — the steady-state loop stays
+                # sync-free (same contract as the Logger's boundary pull).
+                sen = jax.device_get(state.sentinel)
+                if int(sen["skipped"]):
+                    logger.write_text(
+                        f"sentinel @ {step_i}: skipped={int(sen['skipped'])} "
+                        f"consecutive={int(sen['consecutive'])} "
+                        f"ema_grad_norm={float(sen['ema_grad_norm']):.4f}"
+                    )
+                if int(sen["consecutive"]) >= train_cfg.sentinel_halt_after:
+                    halted = True
+                    break
             if step_i % train_cfg.val_freq == 0 or step_i == total:
-                ckpt.save(state)
-                ckpt.wait()
+                ckpt.save(state)  # synchronous: committed on return
                 run_validation(step_i)
-        if step_guard is not None:
+        # ---- post-loop: clean completion / preemption / sentinel halt --
+        if preempted:
+            # The one atomic preemption checkpoint: every process agreed
+            # on this step, orbax commits the step directory atomically,
+            # resume metadata rides along. Skip when the val_freq
+            # boundary of this very step already saved it — orbax raises
+            # StepAlreadyExists for a re-save, which would turn a clean
+            # preemption into a crash exit.
+            if ckpt.latest_step != step_i:
+                ckpt.save(state)  # synchronous: committed on return
+            logger.write_text(
+                f"preempted @ {step_i}: checkpoint saved, exiting "
+                f"{EXIT_PREEMPTED}"
+            )
+            status = EXIT_PREEMPTED
+        elif halted:
+            logger.write_text(
+                f"sentinel halt @ {step_i}: "
+                f">={train_cfg.sentinel_halt_after} consecutive bad steps"
+            )
+            # Skip-updates kept the in-memory params last-good, but a
+            # persistent bad streak means the run has gone wrong: roll
+            # back to the last checkpoint on disk and hand the decision
+            # to the operator via the distinct exit code.
+            if ckpt.latest_step is not None:
+                state = ckpt.restore(state)
+                logger.write_text(
+                    f"rolled back to last good checkpoint "
+                    f"(step {int(state.step)})"
+                )
+            else:
+                logger.write_text("no checkpoint available to roll back to")
+            status = EXIT_DIVERGED
+        if step_guard is not None and status == 0:
             s = step_guard.stats
             logger.write_text(
                 f"strict_guards: warmup_compiles={s.warmup_compiles} "
@@ -251,16 +366,42 @@ def main(argv=None) -> None:
                 f"sanctioned_gets={s.sanctioned_gets}"
             )
             step_guard.check()  # raises on steady-state recompilation
+        # Per-run IO-fault accounting: a run that survived on retries or
+        # quarantined samples says so in log.txt.
+        if not loader.retry_stats.clean:
+            logger.write_text("io-retry: " + loader.retry_stats.summary())
+        if not ckpt.retry_stats.clean:
+            logger.write_text("ckpt-retry: " + ckpt.retry_stats.summary())
     finally:
-        loop_scope.close()
-        profile_scope.close()
-        prefetcher.close()  # joins the worker; closes the batches generator
-        ckpt.save(state)
-        ckpt.wait()
-        ckpt.close()
-        logger.close()
-    print(f"done: {int(state.step)} steps, checkpoints in {run_dir}")
+        # Teardown ONLY. The final save belongs to the clean paths above
+        # (natural completion saves at the step_i == total boundary;
+        # preemption saves explicitly): re-saving here after a mid-loop
+        # crash would persist a possibly-inconsistent step, and a save
+        # failure would shadow the loop's real exception. Closers are
+        # individually shielded for the same reason — teardown noise must
+        # never outrank the error that got us here.
+        for closer in (
+            loop_scope.close,
+            profile_scope.close,
+            prefetcher.close,
+            ckpt.close,
+            logger.close,
+        ):
+            try:
+                closer()
+            except Exception as e:
+                print(f"teardown ({closer.__qualname__}): {e}",
+                      file=sys.stderr)
+    if status == 0:
+        print(f"done: {int(state.step)} steps, checkpoints in {run_dir}")
+    else:
+        kind = "preempted" if preempted else "diverged"
+        print(
+            f"{kind}: exiting {status} at step {step_i}, "
+            f"checkpoints in {run_dir}"
+        )
+    return status
 
 
 if __name__ == "__main__":
-    main(sys.argv[1:])
+    sys.exit(main(sys.argv[1:]))
