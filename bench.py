@@ -39,6 +39,7 @@ import sys
 import time
 
 _CHILD_ENV = "_RAFT_NCUP_BENCH_CHILD"
+_VAL_CHILD_ENV = "_RAFT_NCUP_BENCH_VAL_CHILD"
 _REPO = os.path.dirname(os.path.abspath(__file__))
 _BASELINE_FILE = os.path.join(_REPO, "docs", "perf_baseline.json")
 
@@ -306,6 +307,41 @@ def _child_main() -> None:
             except Exception as e:  # never lose the earlier rows
                 print(f"checkpoint bench failed: {e}", file=sys.stderr)
 
+    # Eval-pipeline row (docs/PERF.md "Eval pipeline"): the pipelined
+    # validation loop (decode-ahead + device-resident metrics + one
+    # end-of-window sync) vs the per-batch-synced loop on the SAME warm
+    # executable. The delta is the decode + sync stall the async eval
+    # pipeline recovers per pair. Independent of the train gate (it is
+    # an inference-path row); BENCH_SKIP_VAL=1 turns it off explicitly.
+    # On CPU the measurement runs in a sub-child whose XLA host pool
+    # leaves a core free for the input pipeline (the serving
+    # configuration — with the default all-cores pool, "overlap" can
+    # only steal compute cores and the comparison measures contention,
+    # not pipelining); accelerators leave the host pool free by nature
+    # and measure in-process against the inference row's variables.
+    if os.environ.get("BENCH_SKIP_VAL") == "1":
+        pass
+    elif child_budget - (time.monotonic() - t0) > 0.12 * child_budget:
+        try:
+            val = None
+            if platform == "cpu":
+                spare = child_budget - (time.monotonic() - t0) - 10.0
+                val = _run_val_child(shape, corr_impl, min(300.0, spare))
+                if val is None:
+                    print(
+                        "val sub-child yielded nothing; measuring "
+                        "in-process (shared XLA pool — expect contention)",
+                        file=sys.stderr,
+                    )
+            if val is None:
+                val = _measure_val_loop(
+                    shape, mixed_precision, corr_impl, variables
+                )
+            record.update(val)
+            _emit(record)
+        except Exception as e:  # never lose the earlier rows
+            print(f"val-loop bench failed: {e}", file=sys.stderr)
+
 
 def _measure_train_step(
     shape: dict, mixed_precision: bool, corr_impl: str
@@ -452,6 +488,196 @@ def _measure_train_loop(handles: dict, steps: int | None = None) -> dict:
     }
 
 
+def _measure_val_loop(
+    shape: dict, mixed_precision: bool, corr_impl: str, variables: dict,
+    n_batches: int | None = None,
+) -> dict:
+    """Wall-clock the PIPELINED eval loop vs the per-batch-synced one —
+    the steady-state validation path (docs/PERF.md "Eval pipeline").
+
+    Both windows run the SAME warm compiled executable — the test-mode
+    forward with the on-device EPE fold (inference/metrics.py) — over
+    the same synthetic frames (style='rigid': its cv2 render cost
+    stands in for the real validators' PNG decode + staging). Only the
+    LOOP STRUCTURE differs:
+
+    - **per-batch-synced** (``val_synced_ms_per_pair``): a FULLY
+      serialized loop — decode/stage inline on the dispatch thread, one
+      ``jax.device_get`` per batch. This brackets the total benefit of
+      the async structure, not this repo's increment alone: the
+      pre-refactor validators already overlapped decode via a prefetch
+      pool but still paid the per-batch sync + full-field pull.
+    - **pipelined** (``val_ms_per_pair``): the refactored loop —
+      decode/stage on worker threads ``depth`` batches ahead
+      (EvalPipeline), dispatch depth bounded per backend
+      (DispatchThrottle), ONE sanctioned ``jax.device_get`` of the
+      accumulator at the window end.
+
+    ``val_stall_ms_per_pair = val_synced_ms_per_pair - val_ms_per_pair``
+    is the per-pair decode + sync stall the async pipeline RECOVERED
+    (positive = the pipelined loop beats the serialized one; note the
+    sign runs opposite to ``train_loop_stall_ms_per_step``, whose
+    comparator EXCLUDES input work — here the comparator contains it).
+    Windows interleave and repeat ``BENCH_VAL_LOOP_REPS`` times with
+    the MINIMUM kept: the recoverable stall is a few percent of a pair
+    at CPU shapes, and min-of-reps filters shared-host scheduling noise
+    a single window cannot.
+
+    On the CPU backend this function is re-entered in a sub-child whose
+    XLA host pool leaves one core free (``_val_child_env``): with the
+    default pool (= all cores) the decode thread can only "overlap" by
+    stealing compute cores, which makes overlap physically impossible
+    on a saturated host — the serving configuration reserves input
+    cores, and the row measures THAT configuration.
+
+    The guarded pipelined rep fills ``val_loop_recompiles`` and
+    ``val_loop_host_transfers``; both must be 0 in steady state — the
+    eval loop inherits the train loop's sync-free/recompile-free
+    invariants. ``BENCH_STRICT_GUARDS=1`` makes a violation raise.
+    """
+    import contextlib
+
+    import jax
+    import numpy as np
+
+    from raft_ncup_tpu.analysis.guards import (
+        GuardStats,
+        RecompileWatchdog,
+        forbid_host_transfers,
+    )
+    from raft_ncup_tpu.config import flagship_config
+    from raft_ncup_tpu.data.synthetic import SyntheticFlowDataset
+    from raft_ncup_tpu.inference import metrics as metrics_mod
+    from raft_ncup_tpu.inference.pipeline import (
+        DispatchThrottle,
+        EvalPipeline,
+        ShapeCachedForward,
+    )
+    from raft_ncup_tpu.models.raft import get_model
+
+    B, H, W = shape["batch"], shape["height"], shape["width"]
+    iters = shape["iters"]
+    n_batches = n_batches or int(os.environ.get("BENCH_VAL_LOOP_BATCHES", "8"))
+    # Batch 0 of every window is the untimed warm step, so the timed
+    # region needs at least one more batch to exist.
+    n_batches = max(2, n_batches)
+    reps = int(os.environ.get("BENCH_VAL_LOOP_REPS", "5"))
+    strict = os.environ.get("BENCH_STRICT_GUARDS") == "1"
+
+    model = get_model(
+        flagship_config(
+            dataset="sintel", mixed_precision=mixed_precision,
+            corr_impl=corr_impl,
+        )
+    )
+    fwd = ShapeCachedForward(model, variables)
+    dataset = SyntheticFlowDataset(
+        (H, W), length=B * n_batches, seed=77, style="rigid"
+    )
+
+    def stage(group: list) -> tuple:
+        return {
+            "image1": np.stack([s["image1"] for s in group]).astype(np.float32),
+            "image2": np.stack([s["image2"] for s in group]).astype(np.float32),
+            "flow": np.stack([s["flow"] for s in group]).astype(np.float32),
+        }, {}
+
+    # Warm-up outside all windows: compile THE executable both windows
+    # share, run one throwaway pipeline round (first worker-thread
+    # spin-up in a process costs a few hundred ms), and prime the tiny
+    # init_acc program.
+    warm_batch, _ = stage([dataset.sample(i) for i in range(B)])
+    acc = fwd.metrics(
+        warm_batch, iters=iters, acc=metrics_mod.init_acc("epe"), kind="epe"
+    )
+    jax.device_get(acc)
+    warm_ds = SyntheticFlowDataset((H, W), length=B, seed=78, style="rigid")
+    with EvalPipeline(warm_ds, stage, batch_size=B, depth=2) as pipe:
+        for _batch, _meta in pipe:
+            pass
+
+    # Both windows time the STEADY STATE: batch 0 is a warm step
+    # executed before the clock starts (the train-loop row's contract —
+    # it fills the pipeline / absorbs first-dispatch jitter), so the
+    # timed region covers n_batches - 1 identical steady iterations.
+    def synced_window() -> float:
+        """Fully serialized comparator: inline decode/stage, same
+        executable, one pull per batch (see the bracketing note in the
+        enclosing docstring)."""
+        acc = metrics_mod.init_acc("epe")
+        batch, _ = stage([dataset.sample(k) for k in range(B)])
+        acc = fwd.metrics(batch, iters=iters, acc=acc, kind="epe")
+        jax.device_get(acc)
+        t0 = time.perf_counter()
+        for g0 in range(B, len(dataset), B):
+            batch, _ = stage([dataset.sample(g0 + k) for k in range(B)])
+            acc = fwd.metrics(batch, iters=iters, acc=acc, kind="epe")
+            jax.device_get(acc)
+        return time.perf_counter() - t0
+
+    def pipelined_window(guarded: bool):
+        stats = GuardStats()
+        wd = None
+        with EvalPipeline(dataset, stage, batch_size=B, depth=2) as pipe:
+            guard = (
+                forbid_host_transfers(stats, raise_on_violation=strict)
+                if guarded else contextlib.nullcontext()
+            )
+            watchdog = RecompileWatchdog() if guarded else contextlib.nullcontext()
+            with watchdog as wd, guard:
+                acc = metrics_mod.init_acc("epe")
+                throttle = DispatchThrottle()
+                batch, _meta = next(iter(pipe))  # warm step: fills pipeline
+                acc = fwd.metrics(batch, iters=iters, acc=acc, kind="epe")
+                throttle.push(acc)
+                t0 = time.perf_counter()
+                for batch, _meta in pipe:
+                    acc = fwd.metrics(batch, iters=iters, acc=acc, kind="epe")
+                    throttle.push(acc)
+                jax.device_get(acc)
+                dt = time.perf_counter() - t0
+        return dt, stats, wd
+
+    # Guarded steady-state rep first: fills the invariant counters and is
+    # EXCLUDED from timing (the pull-guard patches add per-call checks).
+    _, g_stats, g_wd = pipelined_window(guarded=True)
+    recompiles = g_wd.count if g_wd is not None else 0
+    transfers = g_stats.host_transfers
+    # Timed windows interleave synced/pipelined so slow drift on a shared
+    # host (frequency scaling, co-tenants) hits both PAIRED windows
+    # equally; the stall estimate is the MEDIAN of per-rep deltas — the
+    # robust estimator of a systematic shift under common drift (a
+    # min-of-each-side difference instead compares two different noise
+    # draws and flips sign at CPU-scale margins).
+    synced_dts, pipe_dts = [], []
+    for _ in range(max(1, reps)):
+        synced_dts.append(synced_window())
+        dt, _, _ = pipelined_window(guarded=False)
+        pipe_dts.append(dt)
+
+    def med(xs: list) -> float:
+        xs = sorted(xs)
+        m = len(xs) // 2
+        return xs[m] if len(xs) % 2 else 0.5 * (xs[m - 1] + xs[m])
+
+    pairs = B * (n_batches - 1)  # batch 0 of each window is the warm step
+    pipe_ms = med(pipe_dts) * 1000.0 / pairs
+    synced_ms = med(synced_dts) * 1000.0 / pairs
+    stall_ms = med(
+        [(s - p) * 1000.0 / pairs for s, p in zip(synced_dts, pipe_dts)]
+    )
+    return {
+        "val_pairs_per_sec": round(1000.0 / pipe_ms, 4),
+        "val_ms_per_pair": round(pipe_ms, 1),
+        "val_synced_ms_per_pair": round(synced_ms, 1),
+        "val_stall_ms_per_pair": round(stall_ms, 1),
+        "val_loop_batches": n_batches,
+        "val_loop_reps": reps,
+        "val_loop_recompiles": recompiles,
+        "val_loop_host_transfers": transfers,
+    }
+
+
 def _measure_checkpoint(handles: dict) -> dict:
     """Time one full-train-state orbax save (+commit wait) and restore at
     the bench shape — the resilience numbers (docs/RESILIENCE.md):
@@ -492,15 +718,78 @@ def _measure_checkpoint(handles: dict) -> dict:
     }
 
 
-def _parse_json_tail(stdout: str):
+def _parse_json_tail(stdout: str, key: str = "value"):
     for line in reversed((stdout or "").strip().splitlines()):
         try:
             out = json.loads(line)
-            if isinstance(out, dict) and "value" in out:
+            if isinstance(out, dict) and key in out:
                 return out
         except ValueError:
             continue
     return None
+
+
+def _val_child_main() -> None:
+    """Forced-CPU val-row child: measures the eval-pipeline windows with
+    an XLA host pool that leaves a core for the input pipeline (the
+    parent set ``--xla_cpu_multi_thread_eigen=false``) and prints the
+    ``val_*`` fields as one JSON line."""
+    import jax
+
+    from raft_ncup_tpu.utils.runtime import (
+        enable_compilation_cache,
+        force_platform,
+    )
+
+    force_platform("cpu")
+    enable_compilation_cache()
+
+    from raft_ncup_tpu.config import flagship_config
+    from raft_ncup_tpu.models.raft import get_model
+
+    shape = json.loads(os.environ["_BENCH_SHAPE"])
+    corr_impl = os.environ.get("BENCH_CORR_IMPL", "volume")
+    model = get_model(
+        flagship_config(
+            dataset="sintel", mixed_precision=False, corr_impl=corr_impl
+        )
+    )
+    variables = model.init(
+        jax.random.PRNGKey(0), (1, shape["height"], shape["width"], 3)
+    )
+    _emit(_measure_val_loop(shape, False, corr_impl, variables))
+
+
+def _run_val_child(shape: dict, corr_impl: str, timeout_s: float):
+    """Run the val row in a sub-child with the serving thread config
+    (one host core reserved for the input pipeline). Returns the val_*
+    fields dict, or None on failure/timeout."""
+    if timeout_s < 45:
+        return None
+    from raft_ncup_tpu.utils.backend_probe import run_watchdogged
+
+    env = dict(os.environ)
+    env.pop(_CHILD_ENV, None)
+    env[_VAL_CHILD_ENV] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["_BENCH_SHAPE"] = json.dumps(shape)
+    env["BENCH_CORR_IMPL"] = corr_impl
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_cpu_multi_thread_eigen=false"
+    ).strip()
+    res = run_watchdogged(
+        [sys.executable, os.path.abspath(__file__)],
+        timeout_s,
+        env=env,
+        cwd=_REPO,
+    )
+    out = _parse_json_tail(res.stdout, key="val_pairs_per_sec")
+    if out is None and not res.timed_out:
+        print(
+            f"val sub-child failed rc={res.returncode}:\n" + res.tail(8),
+            file=sys.stderr,
+        )
+    return out
 
 
 def _run_child(env_overrides: dict, shape: dict, timeout_s: float):
@@ -539,6 +828,9 @@ def _run_child(env_overrides: dict, shape: dict, timeout_s: float):
 
 
 def main() -> None:
+    if os.environ.get(_VAL_CHILD_ENV) == "1":
+        _val_child_main()
+        return
     if os.environ.get(_CHILD_ENV) == "1":
         _child_main()
         return

@@ -74,6 +74,9 @@ def main(argv=None) -> None:
         mesh = make_mesh(data=1, spatial=args.spatial_parallel)
 
     iters_kw = {"iters": args.iters} if args.iters is not None else {}
+    val_kw = dict(iters_kw)
+    if getattr(args, "batch_size", None):
+        val_kw["batch_size"] = args.batch_size
     if args.submission:
         if args.dataset == "sintel":
             kwargs = dict(iters_kw)
@@ -97,7 +100,7 @@ def main(argv=None) -> None:
         return
 
     results = VALIDATORS[args.dataset](
-        model, variables, data_cfg, mesh=mesh, **iters_kw
+        model, variables, data_cfg, mesh=mesh, **val_kw
     )
     print(results)
 

@@ -17,6 +17,7 @@ from raft_ncup_tpu.analysis.rules import (
     jgl005_dtype_hygiene,
     jgl006_partition_axes,
     jgl007_swallowed_exceptions,
+    jgl008_eval_loop_pulls,
 )
 
 ALL_RULES = (
@@ -27,6 +28,7 @@ ALL_RULES = (
     jgl005_dtype_hygiene,
     jgl006_partition_axes,
     jgl007_swallowed_exceptions,
+    jgl008_eval_loop_pulls,
 )
 
 RULES_BY_ID = {mod.RULE_ID: mod for mod in ALL_RULES}

@@ -1,0 +1,114 @@
+"""JGL008 — per-iteration host pull in the eval/inference hot loop.
+
+The eval pipeline's contract (inference/pipeline.py, docs/PERF.md "Eval
+pipeline") is the Logger's, applied to validation: metrics accumulate ON
+DEVICE inside the jitted forward and the host pulls a handful of scalars
+ONCE per dataset window — never per batch. A ``jax.device_get`` (or an
+``.item()``/``.tolist()``) inside the eval loop re-serializes dispatch
+with device→host transfer every iteration, which is exactly the stall
+the subsystem exists to remove (the pre-refactor validators pulled two
+full flow fields per batch, ~4.4 MB/pair at 368x768).
+
+Scoped to ``raft_ncup_tpu/inference/`` and ``evaluation.py``. Flags the
+pull calls only when they execute per loop iteration (``for``/``while``
+bodies and comprehensions); a function merely *defined* inside a loop is
+not flagged at its definition site. ``jax.block_until_ready`` is
+deliberately NOT flagged: it is a sync without a transfer — the
+DispatchThrottle's bounded in-flight wait is part of the sanctioned
+steady state. Audited exceptions (the AsyncDrain worker, which IS the
+sanctioned off-dispatch pull; the Sintel warm-start's inherent serial
+low-res pull) go through the allowlist with justifications.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from raft_ncup_tpu.analysis.astutil import (
+    FUNC_NODES,
+    Finding,
+    ModuleContext,
+    dotted_name,
+    parent,
+    qualname,
+)
+
+RULE_ID = "JGL008"
+SUMMARY = (
+    "per-iteration host pull (device_get/.item()/.tolist()) in the "
+    "eval hot loop (inference/, evaluation.py)"
+)
+
+_PULL_CALLS = frozenset({"jax.device_get"})
+_PULL_METHODS = frozenset({"item", "tolist"})
+_LOOP_NODES = (
+    ast.For,
+    ast.AsyncFor,
+    ast.While,
+    ast.ListComp,
+    ast.SetComp,
+    ast.DictComp,
+    ast.GeneratorExp,
+)
+
+
+def _in_scope(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return (
+        "/inference/" in p
+        or p.startswith("inference/")
+        or p.endswith("/evaluation.py")
+        or p == "evaluation.py"
+    )
+
+
+def _executes_per_iteration(node: ast.AST) -> bool:
+    """True when ``node`` runs once per iteration of an enclosing loop:
+    the nearest loop ancestor is reached before any function-definition
+    boundary (a nested def's body runs when called, not when defined)."""
+    cur = parent(node)
+    while cur is not None:
+        if isinstance(cur, _LOOP_NODES):
+            return True
+        if isinstance(cur, FUNC_NODES):
+            return False
+        cur = parent(cur)
+    return False
+
+
+def check(ctx: ModuleContext) -> Iterator[Finding]:
+    if not _in_scope(ctx.path):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _executes_per_iteration(node):
+            continue
+        dn = dotted_name(node.func, ctx.aliases)
+        if dn in _PULL_CALLS:
+            yield Finding(
+                ctx.path,
+                node.lineno,
+                node.col_offset,
+                RULE_ID,
+                f"`{dn}` inside the eval loop pulls to host every "
+                "iteration; keep the accumulator on device and pull once "
+                "per window, or route full-field pulls through AsyncDrain",
+                qualname(node),
+            )
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _PULL_METHODS
+            and not node.args
+        ):
+            yield Finding(
+                ctx.path,
+                node.lineno,
+                node.col_offset,
+                RULE_ID,
+                f"`.{node.func.attr}()` inside the eval loop is a "
+                "per-iteration device→host sync; accumulate on device and "
+                "pull once per window",
+                qualname(node),
+            )

@@ -140,6 +140,14 @@ def add_data_args(parser: argparse.ArgumentParser) -> None:
                         help="bounded-backoff retries for failed dataset "
                         "reads before quarantining the sample "
                         "(resilience/retry.py)")
+    parser.add_argument("--eval_cache_size", type=int, default=d.eval_cache_size,
+                        help="LRU bound on shape-cached compiled eval "
+                        "executables (inference/pipeline.py); evictions "
+                        "are counted and logged")
+    parser.add_argument("--eval_pad_bucket", type=int, default=d.eval_pad_bucket,
+                        help="round padded eval shapes up to multiples of "
+                        "this bucket (0=off) so KITTI's shape diversity "
+                        "compiles a small fixed executable set")
     parser.add_argument("--synthetic_ok", action="store_true",
                         help="fall back to procedural data if roots missing")
     parser.add_argument("--synthetic_style", default=d.synthetic_style,
@@ -306,6 +314,8 @@ def data_config_from_args(args: argparse.Namespace) -> DataConfig:
         compressed_ft=args.compressed_ft,
         num_workers=args.num_workers,
         device_prefetch=args.device_prefetch,
+        eval_cache_size=args.eval_cache_size,
+        eval_pad_bucket=args.eval_pad_bucket,
         io_retries=args.io_retries,
         synthetic_ok=args.synthetic_ok,
         synthetic_style=args.synthetic_style,
@@ -347,6 +357,10 @@ def build_eval_parser() -> argparse.ArgumentParser:
                         help="GRU iteration override; default keeps each "
                         "validator's reference setting (sintel 32, "
                         "chairs/kitti 24 — reference evaluate.py)")
+    parser.add_argument("--batch_size", type=int, default=None,
+                        help="validation batch-size override (default "
+                        "keeps each validator's preset); frames group "
+                        "per padded shape, short groups on shape change")
     add_model_args(parser)
     add_data_args(parser)
     add_platform_arg(parser)

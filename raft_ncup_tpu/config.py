@@ -203,6 +203,17 @@ class DataConfig:
     # sample is quarantined and substituted; accounting lands in log.txt.
     io_retries: int = 3
     io_retry_backoff_s: float = 0.05
+    # --- eval/inference pipeline (inference/pipeline.py) ----------------
+    # Bound on the shape-cached compiled eval executables (LRU). Each
+    # distinct (padded shape, iters, metric kind) compiles once; KITTI's
+    # native-shape diversity is what the bound protects against —
+    # evictions are counted and logged loudly.
+    eval_cache_size: int = 8
+    # Round padded eval shapes up to multiples of this bucket (0 = off).
+    # Collapses KITTI's couple-dozen native resolutions onto a small
+    # fixed shape set so the executable count is known up front. Must be
+    # a multiple of 8 when set; applied to the KITTI validator/submission.
+    eval_pad_bucket: int = 0
     # When no dataset is present on disk, the loader can serve procedurally
     # generated pairs so training/benchmarking still exercises the full path.
     synthetic_ok: bool = False

@@ -1,0 +1,16 @@
+"""Asynchronous inference subsystem: device-resident validation metrics
+(:mod:`raft_ncup_tpu.inference.metrics`) and the double-buffered eval
+executor / bounded shape cache / async d2h drain
+(:mod:`raft_ncup_tpu.inference.pipeline`). ``evaluation.py``'s
+validators and submission writers are built on these; docs/PERF.md
+("Eval pipeline") records the measured overlap win."""
+
+from raft_ncup_tpu.inference.pipeline import (  # noqa: F401
+    AsyncDrain,
+    DispatchThrottle,
+    EvalPipeline,
+    SamplePrefetcher,
+    ShapeCachedForward,
+    default_inflight,
+    uniform_batches,
+)

@@ -1,0 +1,157 @@
+"""Device-resident validation metrics: EPE / px-threshold / KITTI F1.
+
+The pre-refactor validators pulled two full flow fields to host every
+batch (~4.4 MB/pair at 368x768 through ``jax.device_get``) and computed
+EPE/F1 in NumPy — the d2h transfer sat on the critical path of every
+eval step. Here the same metrics are computed ON DEVICE, inside the same
+jitted program as the forward (``RAFT.apply(..., metric_head=...)``), and
+carried across batches as a small accumulator vector of SUMS. Validation
+pulls a handful of scalars once per window instead of flow fields once
+per batch; the sums are also exactly what the multi-host reduction needs
+(``allreduce_sum_across_hosts`` in evaluation.py).
+
+Accumulator layouts (float32 sums, host-reducible):
+
+- ``"epe"``      (2,) ``[epe_sum, n_px]`` — chairs / synthetic-smooth.
+- ``"px"``       (5,) ``[epe_sum, n_px, n_lt_1px, n_lt_3px, n_lt_5px]``
+  — sintel (reference: evaluate.py:111-143).
+- ``"kitti"``    (4,) ``[frame_epe_mean_sum, n_frames, n_outliers,
+  n_valid_px]`` — per-frame EPE mean, pixel-pooled F1 (reference:
+  evaluate.py:146-182).
+- ``"epe_band"`` (6,) ``[epe_sum, n_px, band_epe_sum, n_band,
+  interior_epe_sum, n_interior]`` — synthetic-rigid boundary-band EPE
+  (the NCUP-vs-bilinear metric, docs/PERF.md). The band mask is computed
+  host-side during decode (cv2.dilate) and shipped as an input array.
+
+Padding awareness: eval inputs are padded to stride/bucket shapes
+(``ops/padding.InputPadder``), so :func:`unpad_in_graph` crops the
+prediction back to the ground truth's native shape INSIDE the graph —
+the pad spec is static per compiled shape, so the crop is free slicing,
+not a runtime mask multiply, and padded pixels can never leak into a
+metric sum.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+# kind -> accumulator length; init_acc/accumulate/finalize all key on it.
+ACC_SIZES = {"epe": 2, "px": 5, "kitti": 4, "epe_band": 6}
+
+
+def init_acc(kind: str) -> jnp.ndarray:
+    """Fresh zeroed accumulator for ``kind`` (device-resident)."""
+    return jnp.zeros((ACC_SIZES[kind],), jnp.float32)
+
+
+def unpad_in_graph(x: jnp.ndarray, pad) -> jnp.ndarray:
+    """Crop padded NHWC predictions back to the native shape in-graph.
+
+    ``pad`` is ``InputPadder.pad_spec`` — ``((top, bottom), (left,
+    right))``, static per compiled shape — so this lowers to a free
+    static slice (the in-graph unpad mask) rather than a runtime select.
+    """
+    (t, b), (l, r) = pad
+    ht, wd = x.shape[-3], x.shape[-2]
+    return x[..., t : ht - b, l : wd - r, :]
+
+
+def accumulate(
+    kind: str,
+    acc: jnp.ndarray,
+    flow_up: jnp.ndarray,
+    gt: jnp.ndarray,
+    valid: Optional[jnp.ndarray] = None,
+    band: Optional[jnp.ndarray] = None,
+    pad=None,
+) -> jnp.ndarray:
+    """Fold one batch into the accumulator; all args device-resident.
+
+    ``flow_up`` is the (possibly padded) (B, H, W, 2) prediction; ``gt``
+    the native-shape ground truth; ``valid`` a (B, H, W) mask in the
+    reference's >= 0.5 convention (kitti only); ``band`` a (B, H, W) 0/1
+    boundary mask (epe_band only). Mirrors the pre-refactor host NumPy
+    formulas exactly, in the same float32 precision the host path used.
+    """
+    if pad is not None:
+        flow_up = unpad_in_graph(flow_up, pad)
+    flow_up = flow_up.astype(jnp.float32)
+    gt = gt.astype(jnp.float32)
+    epe = jnp.sqrt(jnp.sum((flow_up - gt) ** 2, axis=-1))  # (B, H, W)
+    n = jnp.float32(epe.size)
+
+    if kind == "epe":
+        delta = jnp.stack([epe.sum(), n])
+    elif kind == "px":
+        delta = jnp.stack(
+            [
+                epe.sum(),
+                n,
+                jnp.sum((epe < 1.0).astype(jnp.float32)),
+                jnp.sum((epe < 3.0).astype(jnp.float32)),
+                jnp.sum((epe < 5.0).astype(jnp.float32)),
+            ]
+        )
+    elif kind == "kitti":
+        vm = (valid >= 0.5).astype(jnp.float32)
+        mag = jnp.sqrt(jnp.sum(gt * gt, axis=-1))
+        out = (epe > 3.0) & ((epe / jnp.maximum(mag, 1e-12)) > 0.05)
+        nv_frame = vm.sum(axis=(1, 2))  # (B,)
+        # Per-frame valid-pixel EPE mean (a zero-valid frame contributes
+        # 0 where the host path produced NaN — degenerate case only).
+        frame_epe = jnp.sum(epe * vm, axis=(1, 2)) / jnp.maximum(
+            nv_frame, 1.0
+        )
+        delta = jnp.stack(
+            [
+                frame_epe.sum(),
+                jnp.float32(epe.shape[0]),
+                jnp.sum(out.astype(jnp.float32) * vm),
+                vm.sum(),
+            ]
+        )
+    elif kind == "epe_band":
+        bm = band.astype(jnp.float32)
+        delta = jnp.stack(
+            [
+                epe.sum(),
+                n,
+                jnp.sum(epe * bm),
+                bm.sum(),
+                jnp.sum(epe * (1.0 - bm)),
+                jnp.sum(1.0 - bm),
+            ]
+        )
+    else:
+        raise ValueError(f"unknown metric kind: {kind!r}")
+    return acc + delta
+
+
+def finalize(kind: str, acc: np.ndarray) -> dict:
+    """Host-side sums -> metric dict (call after the window's single
+    ``jax.device_get`` and any cross-host reduction)."""
+    acc = np.asarray(acc, np.float64)
+    if kind == "epe":
+        return {"epe": float(acc[0] / acc[1])}
+    if kind == "px":
+        return {
+            "epe": float(acc[0] / acc[1]),
+            "1px": float(acc[2] / acc[1]),
+            "3px": float(acc[3] / acc[1]),
+            "5px": float(acc[4] / acc[1]),
+        }
+    if kind == "kitti":
+        return {
+            "epe": float(acc[0] / acc[1]),
+            "f1": 100.0 * float(acc[2] / acc[3]),
+        }
+    if kind == "epe_band":
+        return {
+            "epe": float(acc[0] / acc[1]),
+            "bnd": float(acc[2] / acc[3]),
+            "interior": float(acc[4] / acc[5]),
+        }
+    raise ValueError(f"unknown metric kind: {kind!r}")

@@ -1,0 +1,495 @@
+"""Asynchronous inference/eval pipeline: decode-ahead, double-buffered
+device staging, bounded shape-cached executables, and a non-blocking
+device→host drain.
+
+The eval loop's steady state mirrors the train loop's (docs/PERF.md):
+
+- **decode ahead** (:class:`SamplePrefetcher`): a thread pool decodes
+  dataset samples ``lookahead`` frames ahead of consumption, order
+  preserved, with the same close/exception contract as
+  ``data/device_prefetch.DevicePrefetcher`` — worker errors re-raise
+  from the consumer's ``next()`` and ``close()`` cancels pending work
+  (the old ``_prefetch_samples`` generator silently blocked on pool
+  shutdown when abandoned mid-validation and never surfaced decode
+  errors until ``.result()``).
+- **stage + transfer ahead** (:class:`EvalPipeline`): host batching /
+  padding runs on the DevicePrefetcher's worker thread and the staged
+  batch moves to device ``depth`` batches ahead of compute — the
+  consumer's ``next()`` returns device-resident arrays.
+- **compute** (:class:`ShapeCachedForward`): one compiled executable per
+  (padded shape, iters, metric kind), bounded by an LRU (KITTI's shape
+  diversity is further collapsed by pad bucketing —
+  ``ops/padding.InputPadder(bucket=...)``). The metric variant folds
+  ``inference/metrics.py`` into the SAME jitted program as the forward
+  (``RAFT.apply(metric_head=...)``), so validation never materializes a
+  full flow field on host.
+- **drain** (:class:`AsyncDrain`): submissions still need full-field
+  pulls; they happen on a worker thread behind dispatch — the window
+  boundary's sanctioned ``jax.device_get``, moved off the hot loop.
+- **bounded dispatch** (:class:`DispatchThrottle`): the number of
+  in-flight compiled programs is capped per backend (1 on CPU, where
+  queued programs execute concurrently on the shared host pool and
+  destroy each other's intra-op parallelism; 2 on accelerators, whose
+  serialized stream just wants to stay fed across dispatch gaps).
+
+Run the whole loop under ``analysis/guards.py``
+(``forbid_host_transfers`` + ``RecompileWatchdog``) and it inherits the
+train loop's invariants: zero implicit host pulls, zero steady-state
+recompiles (tests/test_inference_pipeline.py pins both; bench.py's
+``val_*`` row records them).
+"""
+
+from __future__ import annotations
+
+import queue
+import sys
+import threading
+from collections import OrderedDict, deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_ncup_tpu.data.device_prefetch import DevicePrefetcher
+from raft_ncup_tpu.inference import metrics as metrics_mod
+
+
+class SamplePrefetcher:
+    """Decode dataset samples ahead of consumption, order-preserving.
+
+    Contracts (aligned with ``DevicePrefetcher``):
+
+    - order: samples come out exactly as ``dataset.sample(0..n-1)``;
+    - exceptions: a decode error re-raises from the consumer's
+      ``next()`` (after closing the pool);
+    - close: cancels queued decodes and joins the pool; idempotent;
+      called automatically on exhaustion and by the context manager, so
+      an early-exiting consumer leaks no threads.
+    """
+
+    def __init__(self, dataset, num_workers: int = 4, lookahead: int = 8):
+        self._ds = dataset
+        self._n = len(dataset)
+        self._pool = ThreadPoolExecutor(
+            max(1, num_workers), thread_name_prefix="eval-decode"
+        )
+        self._futures: deque = deque()
+        self._submitted = 0
+        self._closed = False
+        for _ in range(min(max(1, lookahead), self._n)):
+            self._submit_next()
+
+    def _submit_next(self) -> None:
+        self._futures.append(
+            self._pool.submit(self._ds.sample, self._submitted)
+        )
+        self._submitted += 1
+
+    def __iter__(self) -> Iterator[dict]:
+        return self
+
+    def __next__(self) -> dict:
+        if self._closed or not self._futures:
+            self.close()
+            raise StopIteration
+        fut = self._futures.popleft()
+        try:
+            sample = fut.result()
+        except BaseException:
+            self.close()
+            raise
+        if self._submitted < self._n:
+            self._submit_next()
+        return sample
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._futures:
+            fut.cancel()
+        self._futures.clear()
+        # Queued work is cancelled above, so the join only waits for
+        # decodes already in flight — bounded, not a full-epoch drain.
+        self._pool.shutdown(wait=True, cancel_futures=True)
+
+    def __enter__(self) -> "SamplePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def uniform_batches(
+    samples: Iterable[dict], batch_size: int
+) -> Iterator[list]:
+    """Group an ordered sample stream into fixed-size same-shape batches.
+
+    Emits a short group on shape change (KITTI's mixed native
+    resolutions — pad bucketing upstream keeps those rare) and at stream
+    end. Batching amortizes dispatch and fills the MXU; the reference
+    evaluates strictly frame-by-frame (evaluate.py:98-104).
+    """
+    pending: list = []
+    shape = None
+    for s in samples:
+        if shape is not None and s["image1"].shape != shape:
+            if pending:
+                yield pending
+            pending = []
+        shape = s["image1"].shape
+        pending.append(s)
+        if len(pending) == batch_size:
+            yield pending
+            pending = []
+    if pending:
+        yield pending
+
+
+class EvalPipeline:
+    """Double-buffered eval executor: decode → stage → transfer, all off
+    the dispatch thread.
+
+    ``stage_fn(group) -> (arrays, meta)`` turns a list of samples into a
+    dict of host numpy arrays (stack + pad) plus a small host-side meta
+    dict (pad spec, group size). Staging runs inside the
+    DevicePrefetcher's worker thread, and the staged arrays are moved to
+    device ``depth`` batches ahead — iterating yields
+    ``(device_batch, meta)`` pairs whose alignment is guaranteed by the
+    single-worker FIFO ordering.
+
+    ``mesh``/``shardings`` forward to the DevicePrefetcher (same
+    transfer policy as the train loop): under an SPMD eval mesh the
+    worker thread device_puts each batch straight into the compiled
+    program's input shardings, so jit dispatch does no re-layout — a
+    default-device transfer would be resharded synchronously on the
+    dispatch thread at every call, which is exactly the per-batch stall
+    this pipeline exists to remove.
+
+    Exceptions from decode or staging re-raise from ``next()``;
+    ``close()`` (or the context manager) tears down both threads and the
+    decode pool even mid-epoch.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        stage_fn: Callable[[list], tuple],
+        *,
+        batch_size: int = 1,
+        depth: int = 2,
+        num_workers: int = 4,
+        lookahead: Optional[int] = None,
+        mesh=None,
+        shardings: Optional[dict] = None,
+    ):
+        self._sp = SamplePrefetcher(
+            dataset,
+            num_workers,
+            lookahead or max(2 * batch_size, num_workers),
+        )
+        self._meta: deque = deque()
+        sp, meta_q = self._sp, self._meta
+
+        def staged():
+            try:
+                for group in uniform_batches(sp, batch_size):
+                    arrays, meta = stage_fn(group)
+                    meta_q.append(meta)
+                    yield arrays
+            finally:
+                # DevicePrefetcher closes this generator from its worker
+                # thread; propagate that to the decode pool so an
+                # abandoned pipeline leaks nothing.
+                sp.close()
+
+        self._pf = DevicePrefetcher(
+            staged(), depth=depth, mesh=mesh, shardings=shardings,
+            drop_keys=(),
+        )
+
+    def __iter__(self) -> Iterator[tuple]:
+        return self
+
+    def __next__(self) -> tuple:
+        batch = next(self._pf)
+        return batch, self._meta.popleft()
+
+    def close(self) -> None:
+        self._pf.close()
+        self._sp.close()
+
+    def __enter__(self) -> "EvalPipeline":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def default_inflight() -> int:
+    """How many dispatched-but-unfinished eval programs to keep in flight.
+
+    On the CPU backend, queued XLA programs execute CONCURRENTLY on the
+    shared host thread pool: two in flight halve each other's intra-op
+    parallelism and thrash cache (measured ~+8% per pair on a 2-core
+    host), so the eval loop keeps exactly ONE in flight and overlaps
+    host decode/staging only. Accelerators execute a serialized stream —
+    ``inflight=2`` leaves one queued program between pushes, which rides
+    out the host's stage/dispatch gap so the device stays fed.
+    ``jax.block_until_ready`` on the bounded tail is a sync, not a
+    transfer: the loop stays clean under ``forbid_host_transfers``.
+    """
+    return 1 if jax.default_backend() == "cpu" else 2
+
+
+class DispatchThrottle:
+    """Bound the number of in-flight device computations in a dispatch
+    loop (see :func:`default_inflight`). ``push(x)`` registers a freshly
+    dispatched output; once ``inflight`` or more are pending it blocks
+    until the OLDEST completes, so at most ``inflight`` programs are
+    ever in flight and ``inflight - 1`` stay queued between pushes
+    (``inflight=1`` ⇒ every push waits for its own program) — bounded
+    software pipelining with no host transfer."""
+
+    def __init__(self, inflight: Optional[int] = None):
+        self.inflight = inflight if inflight is not None else default_inflight()
+        self._pending: deque = deque()
+
+    def push(self, x) -> None:
+        self._pending.append(x)
+        while len(self._pending) >= max(1, self.inflight):
+            jax.block_until_ready(self._pending.popleft())
+
+    def drain(self) -> None:
+        while self._pending:
+            jax.block_until_ready(self._pending.popleft())
+
+
+class AsyncDrain:
+    """Non-blocking, order-preserving device→host drain.
+
+    ``submit(tree, callback)`` parks a device-array pytree on a bounded
+    queue; a worker thread performs the sanctioned ``jax.device_get``
+    and hands the host arrays to ``callback``. The dispatch thread never
+    blocks on d2h — full-field pulls (submission writers) overlap the
+    next frame's compute. A worker error re-raises from the next
+    ``submit()`` or from ``close()``; ``close()`` flushes the queue and
+    joins. The queue bound (``depth``) also bounds device memory pinned
+    by in-flight pulls.
+    """
+
+    def __init__(self, depth: int = 2):
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._exc: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._worker, name="eval-drain", daemon=True
+        )
+        self._thread.start()
+
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            if self._exc is not None:
+                continue  # keep consuming so the producer never deadlocks
+            tree, callback = item
+            try:
+                callback(jax.device_get(tree))
+            except BaseException as e:  # noqa: BLE001 — surfaced to producer
+                self._exc = e
+
+    def _raise_pending(self) -> None:
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+
+    def submit(self, tree, callback: Callable) -> None:
+        self._raise_pending()
+        self._q.put((tree, callback))
+
+    def close(self) -> None:
+        """Flush remaining work, stop the worker, re-raise its error."""
+        if self._thread.is_alive():
+            self._q.put(None)
+            self._thread.join()
+        self._raise_pending()
+
+    def __enter__(self) -> "AsyncDrain":
+        return self
+
+    def __exit__(self, et, ev, tb) -> None:
+        if et is not None:
+            # The body already failed; tear down without masking it.
+            try:
+                self.close()
+            except Exception as e:
+                print(f"AsyncDrain close after error: {e}", file=sys.stderr)
+            return
+        self.close()
+
+
+class ShapeCachedForward:
+    """Bounded LRU of compiled test-mode executables, keyed by (padded
+    shape, iters, warm-start presence, metric kind/pad).
+
+    Frames stream with dataset-dependent sizes, so each unique padded
+    shape compiles once; the LRU bound (default 8, knob:
+    ``DataConfig.eval_cache_size``) keeps KITTI-style shape diversity
+    from growing the cache without limit, and ``stats`` counts
+    compiles/hits/evictions so an eviction storm is visible instead of
+    silent recompile churn (pair with pad bucketing,
+    ``InputPadder(bucket=...)``, to make the executable set small and
+    known up front).
+
+    With ``mesh`` set (a (data, spatial) ``jax.sharding.Mesh``) every
+    forward is one SPMD program: images sharded over (batch, height),
+    variables/metrics replicated — the driver-level entry to
+    spatially-sharded high-res eval (models/raft.py).
+    """
+
+    def __init__(self, model, variables: dict, mesh=None, cache_size: int = 8):
+        self.model = model
+        self.variables = variables
+        self.mesh = mesh
+        self.cache_size = max(1, int(cache_size))
+        self._fns: OrderedDict = OrderedDict()
+        self.stats = {"compiles": 0, "hits": 0, "evictions": 0}
+
+    # ------------------------------------------------------------ internals
+
+    def _jit(self, fn, n_img_args: int, n_repl_args: int, n_out: int,
+             donate: tuple = ()):
+        if self.mesh is None:
+            return jax.jit(fn, donate_argnums=donate)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(self.mesh, P())
+        img = NamedSharding(self.mesh, P("data", "spatial"))
+        return jax.jit(
+            fn,
+            in_shardings=(repl,) + (img,) * n_img_args + (repl,) * n_repl_args,
+            out_shardings=repl if n_out == 1 else (repl,) * n_out,
+            donate_argnums=donate,
+        )
+
+    def _get(self, key, build):
+        fn = self._fns.get(key)
+        if fn is not None:
+            self._fns.move_to_end(key)
+            self.stats["hits"] += 1
+            return fn
+        fn = build()
+        self._fns[key] = fn
+        self.stats["compiles"] += 1
+        if len(self._fns) > self.cache_size:
+            evicted, _ = self._fns.popitem(last=False)
+            self.stats["evictions"] += 1
+            print(
+                f"ShapeCachedForward: EVICTING compiled executable "
+                f"{evicted} (LRU bound {self.cache_size}). Recurring "
+                "evictions mean eval shape churn is re-paying compiles — "
+                "raise eval_cache_size or bucket pads (eval_pad_bucket).",
+                file=sys.stderr,
+            )
+        return fn
+
+    # ------------------------------------------------------------- forwards
+
+    def forward_device(self, image1, image2, iters: int, flow_init=None):
+        """Test-mode forward; returns DEVICE arrays (flow_lr, flow_up).
+
+        The caller owns the pull: submissions hand the result to an
+        :class:`AsyncDrain`, the legacy ``__call__`` wraps it in one
+        explicit ``jax.device_get``.
+        """
+        key = (tuple(image1.shape), iters, flow_init is not None)
+
+        def build():
+            mesh = self.mesh
+            if flow_init is None:
+
+                def fn(v, i1, i2):
+                    return self.model.apply(
+                        v, i1, i2, iters=iters, test_mode=True, mesh=mesh
+                    )
+
+            else:
+
+                def fn(v, i1, i2, finit):
+                    return self.model.apply(
+                        v, i1, i2, iters=iters, flow_init=finit,
+                        test_mode=True, mesh=mesh,
+                    )
+
+            return self._jit(
+                fn, 2 if flow_init is None else 3, 0, n_out=2
+            )
+
+        args = (jnp.asarray(image1), jnp.asarray(image2))
+        if flow_init is not None:
+            args += (jnp.asarray(flow_init),)
+        return self._get(key, build)(self.variables, *args)
+
+    def __call__(self, image1, image2, iters: int, flow_init=None):
+        """Back-compat numpy-out forward: ONE explicit batched pull for
+        both outputs (the eval-side analogue of the Logger's
+        one-get-per-window)."""
+        return jax.device_get(
+            self.forward_device(image1, image2, iters, flow_init)
+        )
+
+    def metrics(self, batch: dict, *, iters: int, acc, kind: str, pad=None):
+        """Forward + on-device metric fold in ONE jitted program.
+
+        ``batch`` holds ``image1``/``image2`` (padded) plus ``flow`` and
+        optionally ``valid``/``band`` at native shape; ``pad`` is the
+        static ``InputPadder.pad_spec``. Returns the updated accumulator
+        (device-resident). No flow field ever reaches the host.
+
+        The accumulator is deliberately NOT donated: donating an operand
+        that is still pending (each batch's ``acc`` is the previous
+        batch's not-yet-computed output) makes ``jit`` dispatch wait for
+        it — measured ~220 ms/call of lost overlap on the CPU backend —
+        and the buffer is a handful of floats, so donation saves nothing.
+        """
+        extras = {
+            k: batch[k] for k in ("flow", "valid", "band") if k in batch
+        }
+        key = (
+            "metrics",
+            tuple(batch["image1"].shape),
+            tuple(batch["flow"].shape),
+            tuple(sorted(extras)),
+            iters,
+            kind,
+            pad,
+        )
+
+        def build():
+            mesh = self.mesh
+
+            def fn(v, i1, i2, extra, acc_in):
+                def head(flow_up):
+                    return metrics_mod.accumulate(
+                        kind,
+                        acc_in,
+                        flow_up,
+                        extra["flow"],
+                        valid=extra.get("valid"),
+                        band=extra.get("band"),
+                        pad=pad,
+                    )
+
+                _, acc_out = self.model.apply(
+                    v, i1, i2, iters=iters, test_mode=True, mesh=mesh,
+                    metric_head=head,
+                )
+                return acc_out
+
+            return self._jit(fn, 2, 2, n_out=1)
+
+        return self._get(key, build)(
+            self.variables, batch["image1"], batch["image2"], extras, acc
+        )

@@ -159,6 +159,7 @@ class RAFT:
         mutable: bool = False,
         mesh=None,
         spatial_axis: str = "spatial",
+        metric_head: Optional[Any] = None,
     ):
         """Estimate optical flow between a pair of NHWC image batches.
 
@@ -166,6 +167,14 @@ class RAFT:
         predictions (iters, B, H, W, 2); (test_mode) the tuple
         ``(flow_lowres, flow_up)``. With ``mutable=True`` additionally
         returns the updated batch_stats as a second element.
+
+        ``metric_head`` (test mode only): a traceable callable applied to
+        the final high-res flow INSIDE this program; the second result
+        element becomes ``metric_head(flow_up)`` instead of the full
+        field. Evaluation folds its on-device metric accumulators
+        (inference/metrics.py) through this hook so the compiled eval
+        program emits a handful of scalars per batch — the full flow
+        field never leaves the device on the validation path.
 
         ``mesh``/``spatial_axis``: when running under a (data x spatial)
         SPMD mesh, the on-the-fly correlation lookup is wrapped in
@@ -384,8 +393,12 @@ class RAFT:
             flow_up = upsample_prediction(
                 coords1, net, final_stats.get("up_mask")
             )
+            if metric_head is not None:
+                flow_up = metric_head(flow_up)
             result = (coords1 - coords0, flow_up)
         else:
+            if metric_head is not None:
+                raise ValueError("metric_head requires test_mode=True")
             result = flow_seq
 
         if mutable:

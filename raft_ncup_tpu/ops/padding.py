@@ -14,10 +14,23 @@ class InputPadder:
     vertical padding below the image (the reference's torch pad spec
     ``[wl, wr, 0, pad_ht]`` is (left, right, top, bottom)). Horizontal
     padding is centered in both modes.
+
+    ``bucket`` > 0 additionally rounds the PADDED height and width up to
+    multiples of ``bucket`` (which must itself be divisible by the
+    stride/divisor). KITTI's native resolutions differ by a few pixels
+    frame to frame, so without bucketing every distinct shape compiles
+    its own eval executable; with e.g. ``bucket=64`` the whole training
+    split collapses onto a small fixed shape set, making the number of
+    compiled programs bounded and known up front
+    (inference/pipeline.ShapeCachedForward pairs its LRU with this).
     """
 
     def __init__(
-        self, dims: tuple[int, ...], mode: str = "sintel", divisor: int = 8
+        self,
+        dims: tuple[int, ...],
+        mode: str = "sintel",
+        divisor: int = 8,
+        bucket: int = 0,
     ):
         # dims is NHWC (B, H, W, C) or HWC (H, W, C). ``divisor`` > 8 is
         # used by spatially-sharded eval: the 1/8-res feature height must
@@ -29,13 +42,29 @@ class InputPadder:
         else:
             self.ht, self.wd = dims[0], dims[1]
         d = divisor
-        pad_ht = (((self.ht // d) + 1) * d - self.ht) % d
-        pad_wd = (((self.wd // 8) + 1) * 8 - self.wd) % 8
+        if bucket:
+            if bucket % d or bucket % 8:
+                raise ValueError(
+                    f"pad bucket {bucket} must be a multiple of the "
+                    f"divisor ({d}) and of the stride (8)"
+                )
+            pad_ht = -self.ht % bucket
+            pad_wd = -self.wd % bucket
+        else:
+            pad_ht = (((self.ht // d) + 1) * d - self.ht) % d
+            pad_wd = (((self.wd // 8) + 1) * 8 - self.wd) % 8
         wpad = (pad_wd // 2, pad_wd - pad_wd // 2)
         if mode == "sintel":
             self._pad = ((pad_ht // 2, pad_ht - pad_ht // 2), wpad)
         else:
             self._pad = ((0, pad_ht), wpad)
+
+    @property
+    def pad_spec(self) -> tuple[tuple[int, int], tuple[int, int]]:
+        """Static ``((top, bottom), (left, right))`` amounts — hashable,
+        so it can key a compiled executable and drive the in-graph unpad
+        crop (inference/metrics.unpad_in_graph)."""
+        return self._pad
 
     def pad(self, *inputs: jax.Array) -> list[jax.Array]:
         spec = ((0, 0), self._pad[0], self._pad[1], (0, 0))

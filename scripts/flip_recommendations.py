@@ -27,11 +27,14 @@ def recommend(record: dict) -> list[str]:
     lines = []
     key = str(record.get("baseline_key", ""))
     if key.startswith("cpu") or not key:
+        # Kernel defaults never flip on CPU data, but the eval-pipeline
+        # row's invariant verdict still matters (a leaking loop is a
+        # leaking loop on any backend).
         return [
             "no accelerator measurement in this record "
             f"(baseline_key={key or 'absent'!r}); defaults stay "
             "corr_impl='volume', RAFT_NCUP_NCONV_IMPL='xla' pending TPU data"
-        ]
+        ] + _val_row_lines(record)
 
     corr = {"volume": record.get("value")}
     for tag in ("onthefly", "pallas"):
@@ -78,10 +81,10 @@ def recommend(record: dict) -> list[str]:
     # under analysis/guards.py): a pipelined-loop number measured while
     # the sync-free/recompile-free invariant was VIOLATED ranks loops, not
     # kernels — flag it before anyone reads the train_loop_* fields as a
-    # clean pipeline measurement. (JGL001 audit note: this script itself
-    # is pure host-side JSON analytics — no per-sample device pulls to
-    # batch here; the eval-side ones lived in evaluation.py's
-    # _ShapeCachedForward and are routed through one jax.device_get.)
+    # clean pipeline measurement. (JGL001/JGL008 audit note: this script
+    # itself is pure host-side JSON analytics — no per-sample device
+    # pulls to batch here; the eval-side ones are routed through the
+    # inference pipeline's one-get-per-window contract.)
     transfers = record.get("train_loop_host_transfers")
     recompiles = record.get("train_loop_recompiles")
     if transfers or recompiles:
@@ -92,6 +95,8 @@ def recommend(record: dict) -> list[str]:
             "measure a stalling loop; fix the leak (see docs/ANALYSIS.md) "
             "before comparing pipeline rows"
         )
+
+    lines.extend(_val_row_lines(record))
 
     nc = record.get("pairs_per_sec_nconv_pallas")
     fell_back = record.get("pairs_per_sec_nconv_pallas_FELL_BACK_TO_XLA")
@@ -133,6 +138,44 @@ def recommend(record: dict) -> list[str]:
     else:
         lines.append("nconv: no pallas row measured; keep 'xla'")
     return lines
+
+
+def _val_row_lines(record: dict) -> list[str]:
+    """Eval-pipeline row (bench.py ``val_*`` fields, docs/PERF.md "Eval
+    pipeline") — the train-loop policy applied to validation: absent row
+    → no lines (older records predate it); nonzero guard counters →
+    the numbers measured a leaking loop and are unusable for pipeline
+    comparisons; clean row → report the recovered stall."""
+    if record.get("val_pairs_per_sec") is None:
+        return []
+    transfers = record.get("val_loop_host_transfers")
+    recompiles = record.get("val_loop_recompiles")
+    if transfers or recompiles:
+        return [
+            "val_loop: INVARIANT VIOLATED during the pipelined eval "
+            f"window ({transfers or 0} implicit host transfer(s), "
+            f"{recompiles or 0} recompile(s)) — the val_* numbers measure "
+            "a leaking loop; fix it (docs/ANALYSIS.md JGL008) before "
+            "reading them as a pipeline measurement"
+        ]
+    stall = record.get("val_stall_ms_per_pair")
+    pipe_ms = record.get("val_ms_per_pair")
+    if stall is None or pipe_ms is None:
+        return [
+            "val_loop: row incomplete (no stall bracketing); rerun bench "
+            "for the full eval-pipeline row"
+        ]
+    if stall > 0:
+        return [
+            f"val_loop: pipelined eval recovers {stall:.1f} ms/pair over "
+            f"the per-batch-synced loop ({pipe_ms:.1f} ms/pair pipelined; "
+            "invariants clean) — keep the async eval pipeline on"
+        ]
+    return [
+        f"val_loop: no stall recovered on this host ({stall:.1f} ms/pair; "
+        "saturated-host or accelerator-absent measurement) — pipeline "
+        "stays on for the invariants; judge speed on accelerator rows"
+    ]
 
 
 def main() -> None:

@@ -102,6 +102,60 @@ class TestRecommend:
         assert any("no volume baseline in record" in l for l in lines)
 
 
+class TestValRow:
+    """Eval-pipeline row handling (bench.py val_* fields): absent row →
+    silent; guard counters nonzero → unusable; clean → stall verdict."""
+
+    def test_absent_val_row_adds_no_lines(self):
+        lines = flip.recommend(_tpu())
+        assert not any("val_loop" in l for l in lines)
+
+    def test_violated_invariants_flag_row_unusable(self):
+        lines = flip.recommend(
+            _tpu(
+                val_pairs_per_sec=10.0, val_ms_per_pair=100.0,
+                val_stall_ms_per_pair=5.0,
+                val_loop_host_transfers=3, val_loop_recompiles=0,
+            )
+        )
+        joined = "\n".join(lines)
+        assert "val_loop: INVARIANT VIOLATED" in joined
+        assert "3 implicit host transfer(s)" in joined
+
+    def test_clean_row_reports_recovered_stall(self):
+        lines = flip.recommend(
+            _tpu(
+                val_pairs_per_sec=10.0, val_ms_per_pair=100.0,
+                val_stall_ms_per_pair=7.5,
+                val_loop_host_transfers=0, val_loop_recompiles=0,
+            )
+        )
+        assert any(
+            "recovers 7.5 ms/pair" in l for l in lines
+        ), lines
+
+    def test_negative_stall_reported_without_flip_advice(self):
+        lines = flip.recommend(
+            _tpu(
+                val_pairs_per_sec=10.0, val_ms_per_pair=100.0,
+                val_stall_ms_per_pair=-2.0,
+                val_loop_host_transfers=0, val_loop_recompiles=0,
+            )
+        )
+        assert any("no stall recovered" in l for l in lines)
+
+    def test_val_row_reported_even_on_cpu_records(self):
+        lines = flip.recommend(
+            {
+                "value": 9.0, "baseline_key": "cpu@h:volume:x",
+                "val_pairs_per_sec": 4.0, "val_ms_per_pair": 250.0,
+                "val_stall_ms_per_pair": 5.0,
+                "val_loop_host_transfers": 0, "val_loop_recompiles": 1,
+            }
+        )
+        assert any("val_loop: INVARIANT VIOLATED" in l for l in lines)
+
+
 class TestMain:
     def _run(self, capsys, monkeypatch, text):
         import io

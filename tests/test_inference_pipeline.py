@@ -1,0 +1,487 @@
+"""Async inference subsystem (raft_ncup_tpu/inference/): pipeline
+contracts (order, exceptions, clean close), the bounded shape cache, the
+device-resident metric parity against the pre-refactor host NumPy
+formulas, and the eval loop's sync-free/recompile-free invariants under
+the runtime guards.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_ncup_tpu.config import DataConfig, small_model_config
+from raft_ncup_tpu.data.synthetic import SyntheticFlowDataset
+from raft_ncup_tpu.inference import metrics as metrics_mod
+from raft_ncup_tpu.inference.pipeline import (
+    AsyncDrain,
+    DispatchThrottle,
+    EvalPipeline,
+    SamplePrefetcher,
+    ShapeCachedForward,
+    uniform_batches,
+)
+from raft_ncup_tpu.models.raft import RAFT
+from raft_ncup_tpu.ops import InputPadder
+
+
+# ------------------------------------------------------------- test rigs
+
+
+class _ListDataset:
+    """Minimal dataset protocol over a list of sample dicts."""
+
+    def __init__(self, samples):
+        self._samples = samples
+
+    def __len__(self):
+        return len(self._samples)
+
+    def sample(self, index):
+        return self._samples[index]
+
+
+class _FailingDataset(_ListDataset):
+    def __init__(self, samples, fail_at: int):
+        super().__init__(samples)
+        self._fail_at = fail_at
+
+    def sample(self, index):
+        if index == self._fail_at:
+            raise ValueError(f"decode failed at {index}")
+        return super().sample(index)
+
+
+class _DummyModel:
+    """apply()-compatible stand-in whose jitted programs compile
+    instantly — exercises the cache/LRU machinery without RAFT compiles."""
+
+    def apply(self, variables, image1, image2, iters=1, flow_init=None,
+              test_mode=True, mesh=None, metric_head=None, **kw):
+        flow_up = jnp.stack([image1[..., 0], image1[..., 1]], axis=-1)
+        if metric_head is not None:
+            return image1.mean(), metric_head(flow_up)
+        return image1.mean(), flow_up
+
+
+def _mk_samples(n, hw=(8, 10)):
+    g = np.random.default_rng(3)
+    return [
+        {
+            "image1": g.random((*hw, 3), np.float32),
+            "image2": g.random((*hw, 3), np.float32),
+            "flow": g.random((*hw, 2), np.float32),
+        }
+        for _ in range(n)
+    ]
+
+
+# ----------------------------------------------------- SamplePrefetcher
+
+
+class TestSamplePrefetcher:
+    def test_order_and_contents(self):
+        samples = _mk_samples(7)
+        with SamplePrefetcher(_ListDataset(samples), num_workers=3,
+                              lookahead=2) as sp:
+            got = list(sp)
+        assert len(got) == 7
+        for a, b in zip(got, samples):
+            np.testing.assert_array_equal(a["image1"], b["image1"])
+
+    def test_exception_propagates_and_pool_closes(self):
+        sp = SamplePrefetcher(
+            _FailingDataset(_mk_samples(6), fail_at=3), num_workers=2
+        )
+        got = []
+        with pytest.raises(ValueError, match="decode failed at 3"):
+            for s in sp:
+                got.append(s)
+        assert len(got) == 3
+        assert sp._pool._shutdown  # pool joined, no leaked threads
+
+    def test_early_exit_closes_pool(self):
+        """The old _prefetch_samples generator, abandoned mid-validation,
+        left its pool threads parked forever; the context manager (and
+        close()) must tear them down."""
+        sp = SamplePrefetcher(_ListDataset(_mk_samples(16)), num_workers=2)
+        next(iter(sp))
+        sp.close()
+        assert sp._pool._shutdown
+        sp.close()  # idempotent
+
+    def test_exhaustion_closes_pool(self):
+        sp = SamplePrefetcher(_ListDataset(_mk_samples(3)), num_workers=2)
+        list(sp)
+        assert sp._pool._shutdown
+
+
+# ------------------------------------------------------ uniform_batches
+
+
+class TestUniformBatches:
+    def test_groups_and_shape_breaks(self):
+        a = {"image1": np.zeros((4, 6, 3), np.float32)}
+        b = {"image1": np.zeros((6, 4, 3), np.float32)}
+        groups = list(uniform_batches(iter([a, a, a, b, b, a]), 2))
+        sizes = [len(g) for g in groups]
+        assert sizes == [2, 1, 2, 1]  # short group at each shape change
+
+
+# --------------------------------------------------------- EvalPipeline
+
+
+class TestEvalPipeline:
+    @staticmethod
+    def _stage(group):
+        return (
+            {"image1": np.stack([s["image1"] for s in group])},
+            {"n": len(group)},
+        )
+
+    def test_yields_device_batches_with_aligned_meta(self):
+        samples = _mk_samples(5)
+        with EvalPipeline(
+            _ListDataset(samples), self._stage, batch_size=2
+        ) as pipe:
+            out = list(pipe)
+        assert [m["n"] for _, m in out] == [2, 2, 1]
+        assert all(isinstance(b["image1"], jax.Array) for b, _ in out)
+        np.testing.assert_allclose(
+            np.asarray(out[0][0]["image1"][1]), samples[1]["image1"],
+            rtol=1e-6,
+        )
+
+    def test_stage_exception_propagates(self):
+        def bad_stage(group):
+            raise RuntimeError("stage blew up")
+
+        with pytest.raises(RuntimeError, match="stage blew up"):
+            with EvalPipeline(
+                _ListDataset(_mk_samples(4)), bad_stage, batch_size=2
+            ) as pipe:
+                list(pipe)
+
+    def test_decode_exception_propagates(self):
+        with pytest.raises(ValueError, match="decode failed"):
+            with EvalPipeline(
+                _FailingDataset(_mk_samples(6), fail_at=2),
+                self._stage,
+                batch_size=2,
+            ) as pipe:
+                list(pipe)
+
+    def test_close_mid_epoch_leaks_no_threads(self):
+        pipe = EvalPipeline(
+            _ListDataset(_mk_samples(32)), self._stage, batch_size=2
+        )
+        next(iter(pipe))
+        pipe.close()
+        deadline = time.time() + 5.0
+        while pipe._pf._thread.is_alive() and time.time() < deadline:
+            time.sleep(0.01)
+        assert not pipe._pf._thread.is_alive()
+        assert pipe._sp._pool._shutdown
+
+
+# ----------------------------------------------------------- AsyncDrain
+
+
+class TestAsyncDrain:
+    def test_order_preserving_callbacks(self):
+        got = []
+        with AsyncDrain(depth=2) as drain:
+            for i in range(6):
+                drain.submit(
+                    jnp.full((3,), i),
+                    lambda host, i=i: got.append((i, float(host[0]))),
+                )
+        assert got == [(i, float(i)) for i in range(6)]
+
+    def test_callback_error_reraises(self):
+        drain = AsyncDrain(depth=1)
+
+        def boom(host):
+            raise RuntimeError("writer failed")
+
+        drain.submit(jnp.zeros(()), boom)
+        with pytest.raises(RuntimeError, match="writer failed"):
+            for _ in range(50):
+                drain.submit(jnp.zeros(()), lambda host: None)
+                time.sleep(0.01)
+            drain.close()
+
+    def test_close_flushes_pending(self):
+        got = []
+        drain = AsyncDrain(depth=4)
+        for i in range(4):
+            drain.submit(jnp.full((1,), i), lambda h, i=i: got.append(i))
+        drain.close()
+        assert got == [0, 1, 2, 3]
+        assert not drain._thread.is_alive()
+
+
+# ----------------------------------------------------- DispatchThrottle
+
+
+class TestDispatchThrottle:
+    def test_bounds_pending_and_drains(self):
+        th = DispatchThrottle(inflight=2)
+        xs = [jnp.full((2,), i) for i in range(5)]
+        for x in xs:
+            th.push(x)
+            assert len(th._pending) <= 1  # <= inflight - 1 after push
+        th.drain()
+        assert not th._pending
+
+    def test_serial_mode_keeps_nothing_pending(self):
+        th = DispatchThrottle(inflight=1)
+        th.push(jnp.zeros((2,)))
+        assert not th._pending
+
+
+# ------------------------------------------------- ShapeCachedForward LRU
+
+
+class TestShapeCacheLRU:
+    def _fwd(self, cache_size):
+        return ShapeCachedForward(
+            _DummyModel(), {"params": {}}, cache_size=cache_size
+        )
+
+    def _img(self, h, w):
+        return np.zeros((1, h, w, 3), np.float32)
+
+    def test_bounded_lru_evicts_and_counts(self, capsys):
+        fwd = self._fwd(cache_size=2)
+        fwd.forward_device(self._img(8, 8), self._img(8, 8), iters=1)
+        fwd.forward_device(self._img(8, 16), self._img(8, 16), iters=1)
+        assert fwd.stats == {"compiles": 2, "hits": 0, "evictions": 0}
+        # Third shape evicts the least-recently-used first shape, loudly.
+        fwd.forward_device(self._img(16, 8), self._img(16, 8), iters=1)
+        assert fwd.stats["evictions"] == 1
+        assert "EVICTING compiled executable" in capsys.readouterr().err
+        # The evicted shape recompiles; the resident one hits.
+        fwd.forward_device(self._img(8, 16), self._img(8, 16), iters=1)
+        assert fwd.stats["hits"] == 1
+        fwd.forward_device(self._img(8, 8), self._img(8, 8), iters=1)
+        assert fwd.stats["compiles"] == 4
+        assert fwd.stats["evictions"] == 2
+
+    def test_lru_recency_order(self):
+        fwd = self._fwd(cache_size=2)
+        fwd.forward_device(self._img(8, 8), self._img(8, 8), iters=1)
+        fwd.forward_device(self._img(8, 16), self._img(8, 16), iters=1)
+        # Touch the first entry so the SECOND is now least-recent...
+        fwd.forward_device(self._img(8, 8), self._img(8, 8), iters=1)
+        fwd.forward_device(self._img(16, 8), self._img(16, 8), iters=1)
+        # ...and the first survives the eviction.
+        fwd.forward_device(self._img(8, 8), self._img(8, 8), iters=1)
+        assert fwd.stats["hits"] == 2
+        assert fwd.stats["compiles"] == 3
+
+    def test_pad_bucketing_collapses_executables(self):
+        """Two KITTI-ish native shapes bucket to ONE padded shape → one
+        compiled executable on the forward path (the submission loop)."""
+        fwd = self._fwd(cache_size=8)
+        for h, w in ((37, 41), (38, 44)):
+            img = np.zeros((1, h, w, 3), np.float32)
+            padder = InputPadder(img.shape, mode="kitti", bucket=48)
+            p1, p2 = padder.pad(img, img)
+            assert np.asarray(p1).shape[1:3] == (48, 48)
+            fwd.forward_device(np.asarray(p1), np.asarray(p2), iters=1)
+        assert fwd.stats == {"compiles": 1, "hits": 1, "evictions": 0}
+
+    def test_bad_bucket_rejected(self):
+        with pytest.raises(ValueError, match="multiple of"):
+            InputPadder((1, 37, 41, 3), bucket=12)  # not divisible by 8
+
+
+# ------------------------------------------- device-metric parity + guards
+
+
+def _epe_band_dataset(n, hw):
+    return SyntheticFlowDataset(hw, length=n, seed=11, style="smooth")
+
+
+class _MaskedValid(_ListDataset):
+    """Synthetic samples with a nontrivial valid mask (upper half of
+    every even frame invalid) so the KITTI fold's masking is exercised."""
+
+    def __init__(self, base):
+        samples = []
+        for i in range(len(base)):
+            s = dict(base.sample(i))
+            valid = np.ones(s["flow"].shape[:2], np.float32)
+            if i % 2 == 0:
+                valid[: valid.shape[0] // 2] = 0.0
+            s["valid"] = valid
+            samples.append(s)
+        super().__init__(samples)
+
+
+@pytest.fixture(scope="module", params=["volume", "onthefly"])
+def tiny_fwd(request):
+    cfg = small_model_config(
+        "raft", dataset="chairs", corr_impl=request.param
+    )
+    model = RAFT(cfg)
+    variables = model.init(jax.random.PRNGKey(0), (1, 40, 48, 3))
+    return ShapeCachedForward(model, variables)
+
+
+class TestDeviceMetricParity:
+    """The acceptance contract: validators' on-device sums reproduce the
+    pre-refactor host-side NumPy computation (reference formulas:
+    evaluate.py:90-182) for both corr implementations."""
+
+    ITERS = 2
+
+    def _run_device(self, fwd, dataset, kind, batch_size=2, pad_mode=None,
+                    with_valid=False):
+        from raft_ncup_tpu.evaluation import _run_metric_pass
+
+        return _run_metric_pass(
+            fwd, dataset, kind=kind, iters=self.ITERS,
+            batch_size=batch_size, pad_mode=pad_mode,
+            with_valid=with_valid, num_workers=2,
+        )
+
+    def _host_flow(self, fwd, group, pad_mode=None):
+        """The pre-refactor per-batch path: stack, pad, forward, PULL
+        full fields, unpad host-side."""
+        img1 = np.stack([s["image1"] for s in group]).astype(np.float32)
+        img2 = np.stack([s["image2"] for s in group]).astype(np.float32)
+        if pad_mode is None:
+            _, flow_up = fwd(img1, img2, self.ITERS)
+            return flow_up
+        padder = InputPadder(img1.shape, mode=pad_mode)
+        p1, p2 = padder.pad(img1, img2)
+        _, flow_up = fwd(np.asarray(p1), np.asarray(p2), self.ITERS)
+        return np.asarray(padder.unpad(flow_up))
+
+    def test_epe_parity_unpadded(self, tiny_fwd):
+        ds = _epe_band_dataset(6, (40, 48))
+        acc = self._run_device(tiny_fwd, ds, "epe")
+        # Host reference: evaluate.py:90-108 (chairs EPE).
+        host = np.zeros(2)
+        for g0 in range(0, 6, 2):
+            group = [ds.sample(g0 + k) for k in range(2)]
+            flow_up = self._host_flow(tiny_fwd, group)
+            for k, s in enumerate(group):
+                epe = np.sqrt(((flow_up[k] - s["flow"]) ** 2).sum(-1))
+                host += (float(epe.sum()), epe.size)
+        np.testing.assert_allclose(acc, host, rtol=1e-4)
+
+    def test_px_parity_padded(self, tiny_fwd):
+        # Native 36x44 pads to 40x48 (sintel-centered), so the in-graph
+        # unpad crop is live in the compiled program.
+        ds = _epe_band_dataset(4, (36, 44))
+        acc = self._run_device(tiny_fwd, ds, "px", pad_mode="sintel")
+        # Host reference: evaluate.py:111-143 (sintel EPE + 1/3/5px).
+        host = np.zeros(5)
+        for g0 in range(0, 4, 2):
+            group = [ds.sample(g0 + k) for k in range(2)]
+            flow_b = self._host_flow(tiny_fwd, group, pad_mode="sintel")
+            for k, s in enumerate(group):
+                epe = np.sqrt(((flow_b[k] - s["flow"]) ** 2).sum(-1))
+                host += (
+                    float(epe.sum()), epe.size,
+                    int((epe < 1).sum()), int((epe < 3).sum()),
+                    int((epe < 5).sum()),
+                )
+        np.testing.assert_allclose(acc[:2], host[:2], rtol=1e-4)
+        # Threshold counts are integers: exact equality required.
+        np.testing.assert_array_equal(acc[2:], host[2:])
+
+    def test_kitti_parity_padded_masked(self, tiny_fwd):
+        ds = _MaskedValid(_epe_band_dataset(4, (36, 44)))
+        acc = self._run_device(
+            tiny_fwd, ds, "kitti", pad_mode="kitti", with_valid=True
+        )
+        # Host reference: evaluate.py:146-182 (KITTI EPE + F1 sums).
+        host = np.zeros(4)
+        for g0 in range(0, 4, 2):
+            group = [ds.sample(g0 + k) for k in range(2)]
+            flow_b = self._host_flow(tiny_fwd, group, pad_mode="kitti")
+            for k, s in enumerate(group):
+                epe = np.sqrt(((flow_b[k] - s["flow"]) ** 2).sum(-1)).ravel()
+                mag = np.sqrt((s["flow"] ** 2).sum(-1)).ravel()
+                val = s["valid"].ravel() >= 0.5
+                out = (epe > 3.0) & ((epe / np.maximum(mag, 1e-12)) > 0.05)
+                host += (
+                    float(epe[val].mean()), 1,
+                    int(out[val].sum()), int(val.sum()),
+                )
+        np.testing.assert_allclose(acc[0], host[0], rtol=1e-4)
+        np.testing.assert_array_equal(acc[1:], host[1:])
+
+    def test_finalize_matches_reference_reduction(self):
+        acc = np.array([10.0, 4.0, 2.0, 3.0, 4.0])
+        m = metrics_mod.finalize("px", acc)
+        assert m == {
+            "epe": 2.5, "1px": 0.5, "3px": 0.75, "5px": 1.0,
+        }
+        k = metrics_mod.finalize("kitti", np.array([6.0, 3.0, 5.0, 50.0]))
+        assert k == {"epe": 2.0, "f1": 10.0}
+
+
+class TestEvalLoopInvariants:
+    """N eval batches under forbid_host_transfers + max_recompiles: only
+    the sanctioned window pull touches the host, and the warm loop never
+    recompiles — the train loop's invariants, inherited by eval."""
+
+    def test_metric_pass_is_sync_free_and_recompile_free(
+        self, forbid_host_transfers, max_recompiles
+    ):
+        cfg = small_model_config("raft", dataset="chairs")
+        model = RAFT(cfg)
+        variables = model.init(jax.random.PRNGKey(0), (1, 40, 48, 3))
+        fwd = ShapeCachedForward(model, variables)
+        from raft_ncup_tpu.evaluation import _run_metric_pass
+
+        ds = _epe_band_dataset(6, (40, 48))
+        # Warm pass compiles the metric executable + init_acc programs.
+        warm = _run_metric_pass(
+            fwd, ds, kind="epe", iters=2, batch_size=2, num_workers=2
+        )
+        with forbid_host_transfers() as stats, max_recompiles(0):
+            guarded = _run_metric_pass(
+                fwd, ds, kind="epe", iters=2, batch_size=2, num_workers=2
+            )
+        assert stats.host_transfers == 0
+        assert stats.sanctioned_gets == 1  # ONE window pull, nothing else
+        np.testing.assert_allclose(guarded, warm, rtol=1e-6)
+
+    def test_validator_outputs_unchanged_by_guards(self):
+        """validate_synthetic through the full pipeline equals a direct
+        old-style host computation over the same held-out split."""
+        from raft_ncup_tpu.evaluation import validate_synthetic
+
+        cfg = small_model_config("raft", dataset="chairs")
+        model = RAFT(cfg)
+        variables = model.init(jax.random.PRNGKey(0), (1, 40, 48, 3))
+        out = validate_synthetic(
+            model, variables, DataConfig(), iters=2, batch_size=2,
+            size_hw=(40, 48), length=4,
+        )
+        fwd = ShapeCachedForward(model, variables)
+        ds = SyntheticFlowDataset((40, 48), length=4, seed=999,
+                                  style="smooth")
+        host = np.zeros(2)
+        for g0 in range(0, 4, 2):
+            group = [ds.sample(g0 + k) for k in range(2)]
+            img1 = np.stack([s["image1"] for s in group]).astype(np.float32)
+            img2 = np.stack([s["image2"] for s in group]).astype(np.float32)
+            _, flow_up = fwd(img1, img2, 2)
+            for k, s in enumerate(group):
+                epe = np.sqrt(((flow_up[k] - s["flow"]) ** 2).sum(-1))
+                host += (float(epe.sum()), epe.size)
+        np.testing.assert_allclose(
+            out["synthetic"], host[0] / host[1], rtol=1e-4
+        )

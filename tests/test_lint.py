@@ -471,6 +471,79 @@ def test_jgl007_out_of_scope_paths_exempt(tmp_path):
 # ------------------------------------------------------------- allowlist
 
 
+def test_jgl008_flags_per_batch_pulls_in_eval_loop(tmp_path):
+    """Per-iteration host pulls in the eval hot loop: the exact bug class
+    the async eval pipeline removed (per-batch full-field device_get)."""
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def validate(fwd, batches):
+            total = 0.0
+            for batch in batches:
+                acc = fwd(batch)
+                total += jax.device_get(acc)[0]
+            return total
+
+        def drain(q):
+            while q:
+                q.pop().item()
+
+        def collect(accs):
+            return [a.tolist() for a in accs]
+        """,
+        name="inference/bad.py",
+    )
+    assert [f.rule for f in findings] == ["JGL008"] * 3
+    assert {f.qualname for f in findings} == {"validate", "drain", "collect"}
+
+
+def test_jgl008_negative_window_pull_throttle_and_nested_def(tmp_path):
+    """Sanctioned shapes: ONE pull at the window boundary (after the
+    loop), a bounded block_until_ready (sync, not transfer), and a pull
+    inside a callback merely DEFINED in the loop (runs off-loop, e.g. on
+    the AsyncDrain worker)."""
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def validate(fwd, batches, throttle):
+            acc = None
+            for batch in batches:
+                acc = fwd(batch)
+                jax.block_until_ready(acc)
+            return jax.device_get(acc)
+
+        def submit_all(drain, outs):
+            for out in outs:
+                def write_cb():
+                    return jax.device_get(out)
+                drain.submit(write_cb)
+        """,
+        name="raft_ncup_tpu/evaluation.py",
+    )
+    assert findings == []
+
+
+def test_jgl008_out_of_scope_paths_exempt(tmp_path):
+    """The same per-iteration pull outside inference//evaluation.py is
+    JGL001's business (when traced) or legitimate driver code."""
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def summarize(metrics_list):
+            return [jax.device_get(m) for m in metrics_list]
+        """,
+        name="training/logger.py",
+        select=["JGL008"],
+    )
+    assert findings == []
+
+
 def test_allowlist_suppresses_with_justification(tmp_path):
     snippet = tmp_path / "mod.py"
     snippet.write_text(
