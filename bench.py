@@ -183,12 +183,20 @@ def _child_main() -> None:
     # On the axon TPU tunnel ``block_until_ready`` returns before the
     # computation finishes; pulling a scalar to host is the only honest
     # synchronization point.
-    rate, rep_times = measure_throughput_detailed(
-        lambda: forward(variables, img1, img2),
-        warmup=2,
-        reps=5,
-        sync=lambda out: np.asarray(out[1][0, 0, 0, 0]),
-    )
+    #
+    # --trace_dir / BENCH_TRACE_DIR banks a jax.profiler device trace of
+    # the timed reps (utils/profiling.trace): on first hardware contact
+    # the same invocation that records the number also records WHERE the
+    # time goes (view with TensorBoard's profile plugin / Perfetto).
+    from raft_ncup_tpu.utils.profiling import trace
+
+    with trace(os.environ.get("BENCH_TRACE_DIR") or None):
+        rate, rep_times = measure_throughput_detailed(
+            lambda: forward(variables, img1, img2),
+            warmup=2,
+            reps=5,
+            sync=lambda out: np.asarray(out[1][0, 0, 0, 0]),
+        )
     pairs_per_sec = shape["batch"] * rate
     flops_per_pair = fwd_flops / shape["batch"]
 
@@ -223,6 +231,8 @@ def _child_main() -> None:
         # deltas interpretable.
         "rep_ms": [round(t * 1e3, 1) for t in rep_times],
     }
+    if os.environ.get("BENCH_TRACE_DIR"):
+        record["trace_dir"] = os.environ["BENCH_TRACE_DIR"]
     if nconv_impl == "pallas":
         counts = nconv_mod.dispatch_counts()
         # Mirror corr_pallas_levels: partial fusion (some call sites gated
@@ -341,6 +351,24 @@ def _child_main() -> None:
             _emit(record)
         except Exception as e:  # never lose the earlier rows
             print(f"val-loop bench failed: {e}", file=sys.stderr)
+
+    # Serving row (docs/SERVING.md; docs/PERF.md "Serving"): steady-state
+    # open-loop serving through the FlowServer front-end — admission,
+    # budget decisions, host staging, micro-batch forward, AsyncDrain
+    # result pull — measured under the runtime guards like the train/val
+    # rows. `serve_recompiles`/`serve_host_transfers` must be 0 in steady
+    # state (the per-batch result pull is the sanctioned explicit
+    # device_get in the drain worker — the product, not a leak).
+    # BENCH_SKIP_SERVE=1 turns it off explicitly.
+    if os.environ.get("BENCH_SKIP_SERVE") == "1":
+        pass
+    elif child_budget - (time.monotonic() - t0) > 0.08 * child_budget:
+        try:
+            record.update(_measure_serve(shape, mixed_precision,
+                                         corr_impl, variables))
+            _emit(record)
+        except Exception as e:  # never lose the earlier rows
+            print(f"serve bench failed: {e}", file=sys.stderr)
 
 
 def _measure_train_step(
@@ -678,6 +706,122 @@ def _measure_val_loop(
     }
 
 
+def _measure_serve(
+    shape: dict, mixed_precision: bool, corr_impl: str, variables: dict,
+    n_requests: int | None = None,
+) -> dict:
+    """Steady-state serving latency/throughput through the FlowServer
+    front-end (serving/server.py; docs/SERVING.md).
+
+    The window is OPEN-LOOP and deliberately under capacity: requests
+    arrive at ~1.3x the calibrated per-pair service time, so the row
+    measures the steady state the latency SLO is written against —
+    admission + staging + micro-batch dispatch + drain-worker pull —
+    not queueing collapse (the burst/shed/degrade behaviors are pinned
+    functionally by tests/test_serving.py, not timed here). p50/p99 are
+    nearest-rank over per-request submit→complete latencies;
+    ``serve_ok`` records the sample count behind them (``serve_requests``
+    is the offered count).
+
+    The whole window runs under the runtime guards: ``serve_recompiles``
+    counts XLA compiles after the warmup compiled the full executable
+    set (must be 0 — the bounded (batch, iters) program set is the
+    recompile-free contract under load), ``serve_host_transfers`` counts
+    IMPLICIT device→host pulls (must be 0 — each batch's single result
+    pull rides the sanctioned explicit ``jax.device_get`` in the
+    AsyncDrain worker). ``serve_shed``/``serve_timeouts``/``serve_errors``
+    must also be 0 here: a row that shed load measured backpressure, not
+    service, and a window that errored is incomplete.
+    BENCH_STRICT_GUARDS=1 makes guard violations raise.
+
+    On CPU the dispatcher and XLA share the host pool; with
+    ``inflight=1`` (the CPU default) programs serialize, so the number
+    is an honest single-stream CPU figure, clearly labeled by the
+    baseline key. On accelerators the same code overlaps staging with
+    device compute.
+    """
+    from raft_ncup_tpu.analysis.guards import (
+        GuardStats,
+        RecompileWatchdog,
+        forbid_host_transfers,
+    )
+    from raft_ncup_tpu.config import ServeConfig, flagship_config
+    from raft_ncup_tpu.models.raft import get_model
+    from raft_ncup_tpu.serving import FlowServer, SyntheticTraffic, replay
+
+    B, H, W = shape["batch"], shape["height"], shape["width"]
+    iters = shape["iters"]
+    n = n_requests or int(os.environ.get("BENCH_SERVE_REQUESTS", "16"))
+    strict = os.environ.get("BENCH_STRICT_GUARDS") == "1"
+
+    # Two budget levels at the bench shape: the idle-load level is the
+    # row's headline; the lower level exists so the warmup compiles the
+    # REAL executable-set size the server would hold in production.
+    levels = (iters, max(1, iters // 2))
+    cfg = ServeConfig(
+        queue_capacity=max(8, n),
+        batch_sizes=(1, 2),
+        iter_levels=levels,
+        recover_patience=2,
+    )
+    model = get_model(
+        flagship_config(
+            dataset="sintel", mixed_precision=mixed_precision,
+            corr_impl=corr_impl,
+        )
+    )
+    server = FlowServer(model, variables, cfg)
+    try:
+        server.warmup((H, W))
+        # Calibrate the open-loop rate on the warm top-level executable:
+        # a couple of sequential requests give the per-pair service time.
+        calib = SyntheticTraffic((H, W), 2, seed=90, style="rigid")
+        t0 = time.perf_counter()
+        for h in replay(server, calib)[0]:
+            h.result(timeout=120.0)
+        per_pair = (time.perf_counter() - t0) / 2.0
+        interval = per_pair * 1.3
+
+        stats = GuardStats()
+        with RecompileWatchdog() as wd, forbid_host_transfers(
+            stats, raise_on_violation=strict
+        ):
+            traffic = SyntheticTraffic(
+                (H, W), n, seed=91, interval_s=interval, style="rigid"
+            )
+            t0 = time.perf_counter()
+            handles, _ = replay(server, traffic)
+            responses = [h.result(timeout=120.0) for h in handles]
+            dt = time.perf_counter() - t0
+    finally:
+        server.drain()
+
+    from raft_ncup_tpu.serving import nearest_rank_ms
+
+    lat = [
+        r.latency_s for r in responses if r.ok and r.latency_s is not None
+    ]
+    sstats = server.stats
+    if not lat:
+        raise RuntimeError(f"no ok responses in serve window: "
+                           f"{sstats.summary()}")
+    return {
+        "serve_pairs_per_sec": round(len(lat) / dt, 4) if dt > 0 else 0.0,
+        "serve_p50_ms": nearest_rank_ms(lat, 0.50),
+        "serve_p99_ms": nearest_rank_ms(lat, 0.99),
+        "serve_requests": n,
+        "serve_ok": len(lat),
+        "serve_interval_ms": round(interval * 1e3, 1),
+        "serve_iters": levels[0],
+        "serve_shed": sstats.shed,
+        "serve_timeouts": sstats.timeouts,
+        "serve_errors": sstats.errors,
+        "serve_budget_drops": server.budget.drops,
+        "serve_recompiles": wd.count,
+        "serve_host_transfers": stats.host_transfers,
+    }
+
+
 def _measure_checkpoint(handles: dict) -> dict:
     """Time one full-train-state orbax save (+commit wait) and restore at
     the bench shape — the resilience numbers (docs/RESILIENCE.md):
@@ -834,6 +978,18 @@ def main() -> None:
     if os.environ.get(_CHILD_ENV) == "1":
         _child_main()
         return
+
+    # --trace_dir DIR: bank a jax.profiler device trace of the primary
+    # measurement's timed reps (ROADMAP: first hardware contact should
+    # record where the time goes, not just how much). Children inherit
+    # it via the environment; env BENCH_TRACE_DIR works identically.
+    import argparse
+
+    ap = argparse.ArgumentParser(add_help=False)
+    ap.add_argument("--trace_dir", default=None)
+    cli_args, _ = ap.parse_known_args()
+    if cli_args.trace_dir:
+        os.environ["BENCH_TRACE_DIR"] = os.path.abspath(cli_args.trace_dir)
 
     t0 = time.monotonic()
 

@@ -9,7 +9,10 @@ with device→host transfer every iteration, which is exactly the stall
 the subsystem exists to remove (the pre-refactor validators pulled two
 full flow fields per batch, ~4.4 MB/pair at 368x768).
 
-Scoped to ``raft_ncup_tpu/inference/`` and ``evaluation.py``. Flags the
+Scoped to ``raft_ncup_tpu/inference/``, ``raft_ncup_tpu/serving/`` (the
+serving dispatcher is the same hot loop facing an open-loop stream: its
+per-batch result pull must ride the AsyncDrain worker, never the
+dispatch thread) and ``evaluation.py``. Flags the
 pull calls only when they execute per loop iteration (``for``/``while``
 bodies and comprehensions); a function merely *defined* inside a loop is
 not flagged at its definition site. ``jax.block_until_ready`` is
@@ -37,7 +40,7 @@ from raft_ncup_tpu.analysis.astutil import (
 RULE_ID = "JGL008"
 SUMMARY = (
     "per-iteration host pull (device_get/.item()/.tolist()) in the "
-    "eval hot loop (inference/, evaluation.py)"
+    "eval/serving hot loop (inference/, serving/, evaluation.py)"
 )
 
 _PULL_CALLS = frozenset({"jax.device_get"})
@@ -58,6 +61,8 @@ def _in_scope(path: str) -> bool:
     return (
         "/inference/" in p
         or p.startswith("inference/")
+        or "/serving/" in p
+        or p.startswith("serving/")
         or p.endswith("/evaluation.py")
         or p == "evaluation.py"
     )

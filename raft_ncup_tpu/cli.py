@@ -23,6 +23,7 @@ from typing import Optional, Sequence
 from raft_ncup_tpu.config import (
     DataConfig,
     ModelConfig,
+    ServeConfig,
     TrainConfig,
     UpsamplerConfig,
 )
@@ -153,6 +154,69 @@ def add_data_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--synthetic_style", default=d.synthetic_style,
                         choices=["smooth", "rigid"],
                         help="procedural generator for the fallback")
+
+
+def str2ints(v: str) -> tuple[int, ...]:
+    """Parse a bare comma list ``"24,16,8"`` (serving-tier flags)."""
+    try:
+        return tuple(int(x) for x in v.split(","))
+    except ValueError:
+        raise argparse.ArgumentTypeError(f"comma-joined ints expected: {v!r}")
+
+
+def add_serve_args(parser: argparse.ArgumentParser) -> None:
+    """Serving-tier knobs (ServeConfig; raft_ncup_tpu/serving/,
+    docs/SERVING.md)."""
+    d = ServeConfig()
+    parser.add_argument("--queue_capacity", type=int,
+                        default=d.queue_capacity,
+                        help="bounded admission queue size; a full queue "
+                        "sheds with an explicit retry-after hint")
+    parser.add_argument("--serve_batch_sizes", type=str2ints,
+                        default=d.batch_sizes,
+                        help="allowed micro-batch programs, ascending "
+                        "(e.g. '1,2,4'); batches pad up to the nearest "
+                        "size so the executable set stays fixed")
+    parser.add_argument("--iter_levels", type=str2ints,
+                        default=d.iter_levels,
+                        help="anytime GRU iteration budget levels, "
+                        "descending quality (e.g. '24,16,8'); the "
+                        "controller walks down under burst")
+    parser.add_argument("--high_water", type=float, default=d.high_water,
+                        help="queue occupancy that degrades the budget "
+                        "one level (immediate)")
+    parser.add_argument("--low_water", type=float, default=d.low_water,
+                        help="occupancy counting toward budget recovery")
+    parser.add_argument("--recover_patience", type=int,
+                        default=d.recover_patience,
+                        help="consecutive calm decisions before the "
+                        "budget recovers one level (hysteresis)")
+    parser.add_argument("--deadline_s", type=float,
+                        default=d.default_deadline_s,
+                        help="default per-request deadline in seconds "
+                        "(unset = no deadline); expired requests get a "
+                        "timeout response before any compute")
+    parser.add_argument("--serve_pad_bucket", type=int, default=d.pad_bucket,
+                        help="round padded request shapes up to multiples "
+                        "of this bucket (0=off) so mixed resolutions "
+                        "batch together")
+    parser.add_argument("--serve_cache_size", type=int, default=d.cache_size,
+                        help="compiled-executable LRU bound; keep >= "
+                        "shapes x batch_sizes x iter_levels")
+
+
+def serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
+    return ServeConfig(
+        queue_capacity=args.queue_capacity,
+        batch_sizes=tuple(args.serve_batch_sizes),
+        iter_levels=tuple(args.iter_levels),
+        high_water=args.high_water,
+        low_water=args.low_water,
+        recover_patience=args.recover_patience,
+        default_deadline_s=args.deadline_s,
+        pad_bucket=args.serve_pad_bucket,
+        cache_size=args.serve_cache_size,
+    )
 
 
 def add_train_args(parser: argparse.ArgumentParser) -> None:

@@ -223,6 +223,77 @@ class DataConfig:
     synthetic_style: str = "smooth"
 
 
+@dataclass(frozen=True)
+class ServeConfig:
+    """Online flow-serving knobs (raft_ncup_tpu/serving/; docs/SERVING.md).
+
+    The executable-set arithmetic the bounds below control: every
+    compiled serving program is keyed by (padded shape, batch size,
+    iteration level), so the steady-state program count is
+    ``n_padded_shapes x len(batch_sizes) x len(iter_levels)`` —
+    ``pad_bucket`` bounds the first factor, the two fixed tuples bound
+    the rest, and ``cache_size`` must be at least their product or the
+    LRU evicts programs the next burst re-pays (ShapeCachedForward logs
+    evictions loudly).
+    """
+
+    # Admission-queue capacity: the backpressure contract. Open-loop
+    # arrivals + an unbounded queue = unbounded p99; a full queue sheds
+    # with an explicit retry_after_s hint instead of queueing.
+    queue_capacity: int = 64
+    # Allowed batch programs, ascending. A micro-batch is padded up to
+    # the nearest size with zero rows so the batch dimension never
+    # compiles a fresh executable mid-burst.
+    batch_sizes: tuple[int, ...] = (1, 2, 4)
+    # Anytime iteration budget levels, descending quality (serving/
+    # budget.py). Level 0 is the idle-load quality; under burst the
+    # controller walks down one level per high-water observation.
+    iter_levels: tuple[int, ...] = (24, 16, 8)
+    high_water: float = 0.75  # occupancy that degrades one level (fast)
+    low_water: float = 0.25  # occupancy that counts toward recovery
+    recover_patience: int = 4  # consecutive calm decisions to recover
+    # Default per-request deadline (seconds from admission; None = no
+    # deadline). Expired requests get a `timeout` response at batch
+    # assembly, before any compute is spent on them.
+    default_deadline_s: float | None = None
+    # Shed hint when no service-time estimate exists yet.
+    default_retry_after_s: float = 0.25
+    # Round padded request shapes up to multiples of this bucket (0 =
+    # off; must be a multiple of 8) — same knob as eval_pad_bucket, so
+    # mixed native resolutions batch together and the padded-shape
+    # factor of the executable set stays small.
+    pad_bucket: int = 0
+    # ShapeCachedForward LRU bound; >= the executable-set product above.
+    cache_size: int = 16
+    # DispatchThrottle in-flight bound (None = per-backend default:
+    # 1 on CPU, 2 on accelerators).
+    inflight: int | None = None
+    # AsyncDrain queue depth (bounds device memory pinned by pulls).
+    drain_depth: int = 2
+    # Admission shape limits: smaller than min breaks the feature
+    # pyramid; larger than max is rejected rather than compiled.
+    min_image_hw: int = 16
+    max_image_hw: tuple[int, int] = (1088, 1920)
+
+    def __post_init__(self) -> None:
+        bs = tuple(int(b) for b in self.batch_sizes)
+        if not bs or any(b <= 0 for b in bs) or list(bs) != sorted(set(bs)):
+            raise ValueError(
+                f"batch_sizes must be ascending unique positives: {bs!r}"
+            )
+        lv = tuple(int(x) for x in self.iter_levels)
+        if not lv or any(x <= 0 for x in lv) or list(lv) != sorted(
+            lv, reverse=True
+        ) or len(set(lv)) != len(lv):
+            raise ValueError(
+                f"iter_levels must be strictly descending positives: {lv!r}"
+            )
+
+    @property
+    def max_batch(self) -> int:
+        return self.batch_sizes[-1]
+
+
 def _to_jsonable(obj: Any) -> Any:
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         return {k: _to_jsonable(v) for k, v in dataclasses.asdict(obj).items()}

@@ -518,6 +518,7 @@ def validate_synthetic(
     model: RAFT, variables: dict, data_cfg: Optional[DataConfig] = None,
     iters: int = 12, batch_size: int = 4, size_hw: tuple[int, int] = (96, 128),
     length: int = 32, mesh=None, style: Optional[str] = None,
+    seed: int = 999,
 ) -> dict:
     """EPE on a HELD-OUT procedural split (seed distinct from the
     training fallback's seed=0) so data-free runs (`--synthetic_ok`,
@@ -532,7 +533,14 @@ def validate_synthetic(
     flow discontinuity) and its complement — the metric pair on which
     guided (NCUP) upsampling is expected to beat bilinear (reference
     claim: core/upsampler.py:75-210). The band mask is computed on the
-    staging thread (cv2.dilate) and shipped to device with the batch."""
+    staging thread (cv2.dilate) and shipped to device with the batch.
+
+    ``seed`` keys the held-out split's content. The default (999) is the
+    historical held-out split; multi-seed callers
+    (scripts/ncup_vs_bilinear.py's bootstrap CI) evaluate the same
+    checkpoint over several disjoint splits to put error bars on the
+    quality claim. Keep any explicit seed away from the training
+    fallback's seed=0."""
     from raft_ncup_tpu.data.synthetic import (
         SyntheticFlowDataset,
         flow_boundary_mask,
@@ -541,7 +549,7 @@ def validate_synthetic(
     if style is None:
         style = data_cfg.synthetic_style if data_cfg else "smooth"
     prefix = "synthetic" if style == "smooth" else f"synthetic_{style}"
-    dataset = SyntheticFlowDataset(size_hw, length=length, seed=999,
+    dataset = SyntheticFlowDataset(size_hw, length=length, seed=seed,
                                    style=style)
     dataset, n, do_reduce = _shard_for_validation(dataset, mesh)
     if n == 0:

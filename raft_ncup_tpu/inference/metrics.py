@@ -100,15 +100,20 @@ def accumulate(
         mag = jnp.sqrt(jnp.sum(gt * gt, axis=-1))
         out = (epe > 3.0) & ((epe / jnp.maximum(mag, 1e-12)) > 0.05)
         nv_frame = vm.sum(axis=(1, 2))  # (B,)
-        # Per-frame valid-pixel EPE mean (a zero-valid frame contributes
-        # 0 where the host path produced NaN — degenerate case only).
+        # A frame with ZERO valid pixels (occluded-out crop, corrupt
+        # mask) must not poison the pool: the host path produced NaN
+        # (0-valid sum / 0 count) and the NaN then swallowed the whole
+        # dataset mean. Such frames contribute nothing — not a zero —
+        # to either the per-frame EPE sum or the frame COUNT, so the
+        # remaining frames' mean is unchanged.
+        has_valid = (nv_frame > 0).astype(jnp.float32)
         frame_epe = jnp.sum(epe * vm, axis=(1, 2)) / jnp.maximum(
             nv_frame, 1.0
         )
         delta = jnp.stack(
             [
                 frame_epe.sum(),
-                jnp.float32(epe.shape[0]),
+                has_valid.sum(),
                 jnp.sum(out.astype(jnp.float32) * vm),
                 vm.sum(),
             ]
@@ -144,9 +149,12 @@ def finalize(kind: str, acc: np.ndarray) -> dict:
             "5px": float(acc[4] / acc[1]),
         }
     if kind == "kitti":
+        # Degenerate pools (every frame all-invalid — acc[1] and acc[3]
+        # both 0) finalize to 0.0, not NaN: 0/0 here used to propagate
+        # into the dataset metric and the submission gate.
         return {
-            "epe": float(acc[0] / acc[1]),
-            "f1": 100.0 * float(acc[2] / acc[3]),
+            "epe": float(acc[0] / acc[1]) if acc[1] else 0.0,
+            "f1": 100.0 * float(acc[2] / acc[3]) if acc[3] else 0.0,
         }
     if kind == "epe_band":
         return {

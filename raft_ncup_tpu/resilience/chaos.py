@@ -21,6 +21,20 @@ Spec grammar (``--chaos`` flag / ``RAFT_NCUP_CHAOS`` env), comma-joined:
   external kill; tests/test_chaos_train.py also covers the
   child-process external-SIGTERM variant.)
 
+Serving events (consumed by ``serving/traffic.py`` + serve.py; the
+coordinate is a request index in the deterministic traffic stream):
+
+- ``burst@N`` — request ``N`` arrives as a simultaneous burst of
+  ``burst_size`` requests → admission control must shed the overflow
+  explicitly and the iteration budget must degrade, not the latency.
+- ``poison@N`` — request ``N``'s first frame is all-NaN → the server
+  must quarantine it alone (``rejected``) while its batch-mates return
+  correct flow.
+- ``sigterm@N`` — reused for serving: a real SIGTERM right after ``N``
+  requests have been submitted → the driver stops submitting and the
+  server drains everything admitted, then exits clean
+  (:data:`EXIT_PREEMPTED`).
+
 NaN injection wraps the *host batch stream* (order-preserving, so batch
 ``i`` of the stream is exactly the batch step ``start_step + i``
 consumes, prefetch depth notwithstanding); the SIGTERM trigger lives in
@@ -38,7 +52,7 @@ import numpy as np
 
 ENV_VAR = "RAFT_NCUP_CHAOS"
 
-_KINDS = ("nan", "ioerror", "sigterm")
+_KINDS = ("nan", "ioerror", "sigterm", "burst", "poison")
 
 
 @dataclass(frozen=True)
@@ -48,11 +62,15 @@ class ChaosSpec:
     nan_steps: frozenset = frozenset()
     ioerror_reads: frozenset = frozenset()
     sigterm_after: Optional[int] = None
+    burst_requests: frozenset = frozenset()
+    poison_requests: frozenset = frozenset()
 
     @classmethod
     def parse(cls, spec: Optional[str]) -> "ChaosSpec":
         nan: set = set()
         ioe: set = set()
+        burst: set = set()
+        poison: set = set()
         sig: Optional[int] = None
         for token in (spec or "").split(","):
             token = token.strip()
@@ -69,18 +87,26 @@ class ChaosSpec:
                 nan.add(n)
             elif kind == "ioerror":
                 ioe.add(n)
+            elif kind == "burst":
+                burst.add(n)
+            elif kind == "poison":
+                poison.add(n)
             else:
                 sig = n
-        return cls(frozenset(nan), frozenset(ioe), sig)
+        return cls(frozenset(nan), frozenset(ioe), sig,
+                   frozenset(burst), frozenset(poison))
 
     @property
     def active(self) -> bool:
         return bool(self.nan_steps or self.ioerror_reads
+                    or self.burst_requests or self.poison_requests
                     or self.sigterm_after is not None)
 
     def render(self) -> str:
         parts = [f"nan@{s}" for s in sorted(self.nan_steps)]
         parts += [f"ioerror@{n}" for n in sorted(self.ioerror_reads)]
+        parts += [f"burst@{n}" for n in sorted(self.burst_requests)]
+        parts += [f"poison@{n}" for n in sorted(self.poison_requests)]
         if self.sigterm_after is not None:
             parts.append(f"sigterm@{self.sigterm_after}")
         return ",".join(parts) or "<none>"

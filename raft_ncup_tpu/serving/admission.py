@@ -1,0 +1,103 @@
+"""Bounded admission queue with load-shedding and backpressure hints.
+
+The queueing-theory fact this module encodes: with open-loop arrivals,
+an unbounded queue converts overload into unbounded latency — every
+request is eventually answered, none in useful time. A bounded queue
+with explicit shedding converts the same overload into a fast, honest
+``shed`` + ``retry_after_s`` for the marginal request while the admitted
+ones keep their latency. The capacity bound is therefore the p99
+contract, not a buffer size.
+
+``offer`` never blocks (the caller is a client thread); ``pop_batch``
+is the dispatcher's side: it blocks for the first request, then greedily
+pops FIFO-adjacent requests sharing the same shape key — dynamic
+micro-batching that never reorders across shapes (a request behind a
+different-shaped head waits its turn; pad bucketing upstream makes
+same-key runs the common case).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, List, Optional
+
+from raft_ncup_tpu.serving.request import FlowRequest
+
+
+class AdmissionQueue:
+    """Thread-safe bounded FIFO of admitted :class:`FlowRequest`."""
+
+    def __init__(self, capacity: int):
+        self.capacity = max(1, int(capacity))
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._paused = False
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
+
+    def offer(self, request: FlowRequest) -> bool:
+        """Admit ``request`` or refuse immediately (full / closed).
+
+        Returns True on admission. Never blocks: shedding is a decision,
+        not a wait — the caller turns False into an explicit ``shed``
+        response with a retry hint.
+        """
+        with self._cond:
+            if self._closed or len(self._q) >= self.capacity:
+                return False
+            self._q.append(request)
+            self._cond.notify()
+            return True
+
+    def close(self) -> None:
+        """Stop admitting; queued requests remain poppable (drain)."""
+        with self._cond:
+            self._closed = True
+            self._paused = False
+            self._cond.notify_all()
+
+    def set_paused(self, paused: bool) -> None:
+        """While paused, ``pop_batch`` yields nothing — even if the
+        consumer was already parked inside it when the pause landed (the
+        flag lives in the condition's predicate, so a pause that
+        happens-before a submit deterministically beats the wakeup).
+        Admission is unaffected: requests queue up against capacity."""
+        with self._cond:
+            self._paused = bool(paused)
+            self._cond.notify_all()
+
+    def pop_batch(
+        self,
+        max_n: int,
+        timeout: Optional[float] = None,
+        key_fn: Optional[Callable[[FlowRequest], object]] = None,
+    ) -> List[FlowRequest]:
+        """Pop the head plus up to ``max_n - 1`` FIFO-adjacent requests
+        sharing its ``key_fn`` value (default: ``shape_key``).
+
+        Blocks up to ``timeout`` for the first request; returns ``[]``
+        on timeout or when closed-and-empty (the dispatcher's exit
+        signal). Requests with a different key stay queued in order.
+        """
+        key_fn = key_fn or (lambda r: r.shape_key)
+        with self._cond:
+            while self._paused or not self._q:
+                if self._closed and not self._q:
+                    return []
+                if not self._cond.wait(timeout):
+                    return []
+            head = self._q.popleft()
+            batch = [head]
+            want = key_fn(head)
+            while self._q and len(batch) < max_n and key_fn(self._q[0]) == want:
+                batch.append(self._q.popleft())
+            return batch

@@ -1,0 +1,111 @@
+"""Load-adaptive anytime iteration budget with hysteresis.
+
+RAFT refines flow iteratively: each GRU iteration improves the estimate,
+and stopping early yields a coarser but structurally valid field
+(PAPERS.md: arXiv:2003.12039 — "RAFT: Recurrent All-Pairs Field
+Transforms"; the reference evaluates the same checkpoint at 12, 24 and
+32 iterations). That makes iteration count a native latency/quality knob
+the serving tier can turn under load — trade EPE for p99 the way
+efficient-correlation work trades memory for resolution (PAPERS.md:
+"Efficient All-Pairs Correlation Volume Sampling").
+
+Two constraints shape the controller:
+
+1. **The level set is small and FIXED** (``levels``, descending, e.g.
+   ``(24, 16, 8)``). Every level is one compiled executable per (shape,
+   batch) — a continuous knob would compile a fresh program per value
+   and recompile-storm the exact burst it exists to absorb.
+2. **Moves have hysteresis.** Degrading is immediate (occupancy ≥
+   ``high_water`` ⇒ one level down — a burst must not wait), but
+   recovering requires ``recover_patience`` CONSECUTIVE decisions at or
+   below ``low_water``: the gap between the watermarks plus the patience
+   window keeps the controller from flapping between two executables at
+   a load sitting exactly on a threshold (each flap re-warms nothing —
+   both programs stay cached — but flapping quality per-request is a
+   worse client contract than a stable coarser answer).
+
+The controller is pure host-side bookkeeping, driven once per batch
+assembly with the queue depth the dispatcher just observed — no clock,
+no device work, deterministic for tests (tests/test_serving.py pins the
+drop/recover trajectories).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+
+class IterationBudgetController:
+    """Map admission-queue occupancy to a GRU iteration budget."""
+
+    def __init__(
+        self,
+        levels: Sequence[int],
+        capacity: int,
+        high_water: float = 0.75,
+        low_water: float = 0.25,
+        recover_patience: int = 4,
+    ):
+        levels = tuple(int(x) for x in levels)
+        if not levels or any(x <= 0 for x in levels):
+            raise ValueError(f"iteration levels must be positive: {levels!r}")
+        if list(levels) != sorted(levels, reverse=True):
+            raise ValueError(
+                f"iteration levels must be strictly descending: {levels!r}"
+            )
+        if not 0.0 <= low_water < high_water <= 1.0:
+            raise ValueError(
+                f"want 0 <= low_water < high_water <= 1, got "
+                f"{low_water}/{high_water}"
+            )
+        self.levels = levels
+        self.capacity = max(1, int(capacity))
+        self.high_water = float(high_water)
+        self.low_water = float(low_water)
+        self.recover_patience = max(1, int(recover_patience))
+        self._level = 0  # index into levels; 0 = full quality
+        self._calm = 0  # consecutive at/below-low_water decisions
+        self.drops = 0
+        self.recoveries = 0
+        self.decisions: List[int] = [0] * len(levels)  # per-level counts
+
+    @property
+    def level(self) -> int:
+        return self._level
+
+    @property
+    def iters(self) -> int:
+        """Current budget without making a decision (reporting only)."""
+        return self.levels[self._level]
+
+    def decide(self, queue_depth: int) -> int:
+        """One decision: observe ``queue_depth``, maybe move one level,
+        return the iteration budget for the batch being assembled."""
+        occ = min(1.0, max(0, int(queue_depth)) / self.capacity)
+        if occ >= self.high_water:
+            self._calm = 0
+            if self._level < len(self.levels) - 1:
+                self._level += 1
+                self.drops += 1
+        elif occ <= self.low_water:
+            self._calm += 1
+            if self._calm >= self.recover_patience and self._level > 0:
+                self._level -= 1
+                self.recoveries += 1
+                self._calm = 0
+        else:
+            # Between the watermarks: hold level, reset patience — a
+            # recovery must be earned by sustained calm, not by load
+            # oscillating through the low band.
+            self._calm = 0
+        self.decisions[self._level] += 1
+        return self.levels[self._level]
+
+    def summary(self) -> str:
+        per = " ".join(
+            f"{it}it={n}" for it, n in zip(self.levels, self.decisions)
+        )
+        return (
+            f"budget: level={self._level} ({self.iters} iters) "
+            f"drops={self.drops} recoveries={self.recoveries} [{per}]"
+        )

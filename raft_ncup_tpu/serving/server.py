@@ -1,0 +1,449 @@
+"""The flow-serving front-end: dynamic micro-batching over a bounded
+executable set, with admission control, deadlines, anytime iteration
+budgets, poison quarantine, and graceful drain.
+
+Data path (one dispatcher thread, clients on their own threads):
+
+1. **submit** (client thread): cheap metadata validation (ndim / dtype /
+   size caps — malformed requests are ``rejected`` before they occupy
+   queue capacity), pad-spec computation (``InputPadder`` with the
+   configured bucket, so the request's batching key is its PADDED
+   shape), then a non-blocking ``AdmissionQueue.offer`` — a full queue
+   sheds with a ``retry_after_s`` hint derived from the live service-
+   time EMA.
+2. **assemble** (dispatcher): pop a FIFO run of same-padded-shape
+   requests, expire the ones past their deadline (``timeout``, zero
+   compute), scan the survivors' pixels for non-finite values — a NaN
+   input is *quarantined alone* (``rejected`` + ``ServeStats``
+   accounting, the ``resilience/retry.py`` discipline) while its
+   batch-mates proceed untouched.
+3. **budget**: one ``IterationBudgetController.decide`` per batch with
+   the queue depth just observed — under burst the GRU iteration count
+   steps down a fixed level set (coarser but valid flow; RAFT's anytime
+   property), with hysteresis on the way back up.
+4. **stage + dispatch**: host-side ``np.pad`` to the padded shape (host
+   pad, not ``jnp.pad`` — the staging path must not compile tiny device
+   programs), zero-row batch padding up to the nearest allowed batch
+   size, then ``ShapeCachedForward.forward_device`` — one compiled
+   program per (padded shape, batch size, iters), LRU-bounded, with
+   ``DispatchThrottle`` capping in-flight programs per backend.
+5. **complete** (drain worker): ``AsyncDrain`` performs the sanctioned
+   ``jax.device_get`` off the dispatch thread, the callback unpads each
+   row back to its native shape (host slicing) and completes the
+   request's handle with latency accounting.
+
+**Drain contract** (``drain()``, reused by serve.py's SIGTERM path via
+``resilience/preemption.PreemptionHandler``): stop admitting (new
+submits shed with ``detail="draining"``), flush every request already
+admitted — through compute, not dropped — then tear down the dispatcher
+and drain worker and return the final ``ServeStats``. Nothing admitted
+is ever silently lost; everything refused is told so explicitly.
+
+Invariants inherited from the rest of the stack: the steady-state
+serving loop performs zero implicit host transfers and zero recompiles
+(tests/test_serving.py pins both under ``analysis/guards.py``; bench.py
+records them as ``serve_recompiles`` / ``serve_host_transfers``). The
+per-batch result pull is the *product* here, not a leak — it flows
+through the one sanctioned explicit ``jax.device_get`` in the
+``AsyncDrain`` worker.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from raft_ncup_tpu.config import ServeConfig
+from raft_ncup_tpu.inference.pipeline import (
+    AsyncDrain,
+    DispatchThrottle,
+    ShapeCachedForward,
+)
+from raft_ncup_tpu.ops.padding import InputPadder
+from raft_ncup_tpu.serving.admission import AdmissionQueue
+from raft_ncup_tpu.serving.budget import IterationBudgetController
+from raft_ncup_tpu.serving.request import (
+    STATUS_ERROR,
+    STATUS_OK,
+    STATUS_REJECTED,
+    STATUS_SHED,
+    STATUS_TIMEOUT,
+    FlowRequest,
+    FlowResponse,
+    ServeHandle,
+    ServeStats,
+)
+
+_POLL_S = 0.05  # dispatcher wake cadence while the queue is idle
+
+
+class FlowServer:
+    """Serve flow requests against one model + variables set.
+
+    ``clock`` is injectable (tests drive deadlines deterministically);
+    it must be monotonic. The server owns one dispatcher thread from
+    construction until :meth:`drain`.
+    """
+
+    def __init__(
+        self,
+        model,
+        variables: dict,
+        cfg: Optional[ServeConfig] = None,
+        *,
+        mesh=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.cfg = cfg or ServeConfig()
+        self._clock = clock
+        self.stats = ServeStats()
+        self._fwd = ShapeCachedForward(
+            model, variables, mesh=mesh, cache_size=self.cfg.cache_size
+        )
+        self._queue = AdmissionQueue(self.cfg.queue_capacity)
+        self.budget = IterationBudgetController(
+            self.cfg.iter_levels,
+            capacity=self.cfg.queue_capacity,
+            high_water=self.cfg.high_water,
+            low_water=self.cfg.low_water,
+            recover_patience=self.cfg.recover_patience,
+        )
+        self._throttle = DispatchThrottle(self.cfg.inflight)
+        self._drainer = AsyncDrain(depth=self.cfg.drain_depth)
+        self._handles: dict[int, ServeHandle] = {}
+        # Batches handed to the AsyncDrain worker and not yet delivered:
+        # the safety net that keeps a drain-worker failure (device_get
+        # error, callback bug) from leaving handles uncompleted forever
+        # — AsyncDrain surfaces worker errors from a LATER submit/close,
+        # so without this registry the error would be attributed to the
+        # wrong batch and the failed batch's clients would hang.
+        self._inflight: dict[int, list] = {}
+        self._inflight_seq = 0
+        self._inflight_lock = threading.Lock()
+        self._service_ema: Optional[float] = None  # seconds per pair
+        self._ema_lock = threading.Lock()
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+        self._draining = threading.Event()
+        self._drained = False
+        self._thread = threading.Thread(
+            target=self._dispatch_loop, name="flow-serve-dispatch",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------ admission
+
+    def submit(
+        self,
+        image1,
+        image2,
+        *,
+        deadline_s: Optional[float] = None,
+    ) -> ServeHandle:
+        """Submit one frame pair; returns immediately with a handle.
+
+        The handle completes with exactly one terminal status (see
+        ``serving/request.py``). ``deadline_s`` is seconds from now
+        (default ``cfg.default_deadline_s``; ``None`` = no deadline).
+        """
+        self.stats.note_submitted()
+        handle = ServeHandle()
+        with self._id_lock:
+            rid = self._next_id
+            self._next_id += 1
+        if self._draining.is_set():
+            self.stats.note_shed()
+            handle.complete(FlowResponse(
+                rid, STATUS_SHED, retry_after_s=self._retry_after(),
+                detail="draining",
+            ))
+            return handle
+        err = self._admission_error(image1) or self._admission_error(image2)
+        if err is None and image1.shape != image2.shape:
+            err = f"frame shapes differ: {image1.shape} vs {image2.shape}"
+        if err is not None:
+            self.stats.note_rejected(rid)
+            handle.complete(FlowResponse(rid, STATUS_REJECTED, detail=err))
+            return handle
+        h, w = int(image1.shape[0]), int(image1.shape[1])
+        padder = InputPadder((h, w, 3), mode="sintel",
+                             bucket=self.cfg.pad_bucket)
+        (t, b), (le, r) = padder.pad_spec
+        deadline_s = (
+            deadline_s if deadline_s is not None
+            else self.cfg.default_deadline_s
+        )
+        now = self._clock()
+        req = FlowRequest(
+            request_id=rid,
+            image1=image1,
+            image2=image2,
+            deadline=None if deadline_s is None else now + deadline_s,
+            submit_time=now,
+            shape_key=(h + t + b, w + le + r),
+            pad_spec=padder.pad_spec,
+            native_hw=(h, w),
+        )
+        self._handles[rid] = handle
+        if not self._queue.offer(req):
+            self._handles.pop(rid, None)
+            self.stats.note_shed()
+            handle.complete(FlowResponse(
+                rid, STATUS_SHED, retry_after_s=self._retry_after(),
+                detail="admission queue full",
+            ))
+            return handle
+        self.stats.note_accepted()
+        return handle
+
+    def _admission_error(self, image) -> Optional[str]:
+        shape = getattr(image, "shape", None)
+        dtype = getattr(image, "dtype", None)
+        if shape is None or dtype is None:
+            return f"not an array: {type(image).__name__}"
+        if len(shape) != 3 or shape[-1] != 3:
+            return f"want (H, W, 3), got shape {tuple(shape)}"
+        if np.dtype(dtype).kind not in "uif":
+            return f"non-numeric dtype {dtype}"
+        h, w = int(shape[0]), int(shape[1])
+        mh, mw = self.cfg.max_image_hw
+        if h < self.cfg.min_image_hw or w < self.cfg.min_image_hw:
+            return f"image {h}x{w} below minimum {self.cfg.min_image_hw}"
+        if h > mh or w > mw:
+            return f"image {h}x{w} exceeds maximum {mh}x{mw}"
+        return None
+
+    def _retry_after(self) -> float:
+        with self._ema_lock:
+            per_pair = self._service_ema
+        if per_pair is None:
+            return self.cfg.default_retry_after_s
+        # Time for the current backlog to clear is the honest hint.
+        return round((len(self._queue) + 1) * per_pair, 4)
+
+    # ------------------------------------------------------------- dispatch
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            batch = self._queue.pop_batch(self.cfg.max_batch,
+                                          timeout=_POLL_S)
+            if not batch:
+                if self._queue.closed and not len(self._queue):
+                    return
+                continue
+            depth = len(self._queue) + len(batch)
+            try:
+                self._process(batch, depth)
+            except BaseException as e:  # noqa: BLE001 — per-request status
+                # The fault is the server's (XLA error, drain-worker
+                # failure...): every still-pending request in the batch
+                # gets an explicit `error` terminal status (requests the
+                # batch already resolved — timeouts, rejects — keep
+                # theirs); the server keeps serving later batches. A
+                # drain-WORKER error re-raises from a later submit, so
+                # the batches it actually stranded are flushed from the
+                # in-flight registry, not blamed on this batch alone.
+                self._fail_inflight(e)
+                for req in batch:
+                    if self._complete(req.request_id, FlowResponse(
+                        req.request_id, STATUS_ERROR, detail=repr(e),
+                    )):
+                        self.stats.note_error()
+
+    def _process(self, batch: list, depth: int) -> None:
+        now = self._clock()
+        live = []
+        for req in batch:
+            if req.deadline is not None and now > req.deadline:
+                self.stats.note_timeout()
+                self._complete(req.request_id, FlowResponse(
+                    req.request_id, STATUS_TIMEOUT,
+                    latency_s=now - req.submit_time,
+                    detail="deadline expired in queue",
+                ))
+                continue
+            poison = self._poison_error(req)
+            if poison is not None:
+                self.stats.note_rejected(req.request_id, quarantine=True)
+                self._complete(req.request_id, FlowResponse(
+                    req.request_id, STATUS_REJECTED, detail=poison,
+                ))
+                continue
+            live.append(req)
+        if not live:
+            return
+        iters = self.budget.decide(depth)
+        ph, pw = live[0].shape_key
+        rows1 = [self._stage(r.image1, r.pad_spec) for r in live]
+        rows2 = [self._stage(r.image2, r.pad_spec) for r in live]
+        n_rows = next(
+            b for b in self.cfg.batch_sizes if b >= len(live)
+        )
+        pad_rows = n_rows - len(live)
+        for _ in range(pad_rows):
+            rows1.append(np.zeros((ph, pw, 3), np.float32))
+            rows2.append(np.zeros((ph, pw, 3), np.float32))
+        self.stats.note_batch(pad_rows)
+        img1 = np.stack(rows1)
+        img2 = np.stack(rows2)
+        t_dispatch = self._clock()
+        _, flow_up = self._fwd.forward_device(img1, img2, iters)
+        self._throttle.push(flow_up)
+        with self._inflight_lock:
+            token = self._inflight_seq
+            self._inflight_seq += 1
+            self._inflight[token] = live
+
+        def deliver(host_flow, live=live, iters=iters, token=token):
+            with self._inflight_lock:
+                self._inflight.pop(token, None)
+            done = self._clock()
+            for k, req in enumerate(live):
+                (t, b), (le, r) = req.pad_spec
+                hh, ww = host_flow.shape[1], host_flow.shape[2]
+                flow = host_flow[k, t: hh - b, le: ww - r, :]
+                self.stats.note_completed()
+                self._complete(req.request_id, FlowResponse(
+                    req.request_id, STATUS_OK, flow=flow, iters=iters,
+                    latency_s=done - req.submit_time,
+                ))
+            # Dispatch->delivery over the batch rows: the per-pair
+            # SERVICE time. Measuring from submit_time would fold queue
+            # wait into the EMA and make the shed hint double-count the
+            # backlog exactly when sheds happen.
+            self._note_service((done - t_dispatch) / len(live))
+
+        self._drainer.submit(flow_up, deliver)
+
+    def _fail_inflight(self, exc: BaseException) -> None:
+        """Complete every batch stranded by a drain-worker failure with
+        an explicit `error` — the no-silent-loss half of the drain
+        contract when the sanctioned pull itself is what broke."""
+        with self._inflight_lock:
+            stranded = list(self._inflight.values())
+            self._inflight.clear()
+        for live in stranded:
+            for req in live:
+                if self._complete(req.request_id, FlowResponse(
+                    req.request_id, STATUS_ERROR,
+                    detail=f"result drain failed: {exc!r}",
+                )):
+                    self.stats.note_error()
+
+    def _poison_error(self, req: FlowRequest) -> Optional[str]:
+        for name, img in (("image1", req.image1), ("image2", req.image2)):
+            arr = np.asarray(img)
+            if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                return f"non-finite pixels in {name}"
+        return None
+
+    def _stage(self, image, pad_spec) -> np.ndarray:
+        (t, b), (le, r) = pad_spec
+        arr = np.asarray(image, np.float32)
+        if t or b or le or r:
+            arr = np.pad(arr, ((t, b), (le, r), (0, 0)), mode="edge")
+        return arr
+
+    def _complete(self, rid: int, response: FlowResponse) -> bool:
+        """Deliver ``response`` if ``rid`` is still pending; True when a
+        handle was actually completed (each request resolves once)."""
+        handle = self._handles.pop(rid, None)
+        if handle is None:
+            return False
+        handle.complete(response)
+        return True
+
+    def _note_service(self, per_pair_s: float) -> None:
+        with self._ema_lock:
+            prev = self._service_ema
+            self._service_ema = (
+                per_pair_s if prev is None
+                else 0.8 * prev + 0.2 * per_pair_s
+            )
+
+    # ------------------------------------------------------------- lifecycle
+
+    def warmup(self, size_hw: tuple) -> int:
+        """Compile the full executable set for one native shape: every
+        (batch size, iteration level) program at its padded/bucketed
+        shape. Returns the number of programs compiled. Call before a
+        latency-sensitive window so no request pays a compile — with pad
+        bucketing, one warmup covers every native shape in the bucket.
+        """
+        import jax
+
+        h, w = size_hw
+        padder = InputPadder((int(h), int(w), 3), mode="sintel",
+                             bucket=self.cfg.pad_bucket)
+        (t, b), (le, r) = padder.pad_spec
+        ph, pw = int(h) + t + b, int(w) + le + r
+        before = self._fwd.stats["compiles"]
+        for n in self.cfg.batch_sizes:
+            zeros = np.zeros((n, ph, pw, 3), np.float32)
+            for iters in self.cfg.iter_levels:
+                out = self._fwd.forward_device(zeros, zeros, iters)
+                jax.block_until_ready(out)
+        return self._fwd.stats["compiles"] - before
+
+    def pause(self) -> None:
+        """Test/ops hook: stop assembling new batches (in-flight ones
+        finish). Queued and newly admitted requests wait. Deterministic:
+        a pause that happens-before a submit is guaranteed to beat the
+        dispatcher to it (the flag lives inside the queue's condition
+        predicate — see AdmissionQueue.set_paused)."""
+        self._queue.set_paused(True)
+
+    def resume(self) -> None:
+        self._queue.set_paused(False)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def drain(self, timeout: Optional[float] = None) -> ServeStats:
+        """Graceful drain: stop admitting, flush everything admitted,
+        tear down, return the final stats. Idempotent."""
+        self._draining.set()
+        self._queue.close()  # also clears any pause: drain must finish
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError(
+                    f"dispatcher did not drain within {timeout}s "
+                    f"({len(self._queue)} requests still queued)"
+                )
+        if not self._drained:
+            self._drained = True
+            self._throttle.drain()
+            try:
+                self._drainer.close()
+            except Exception as e:
+                # The drain worker died with batches in flight: their
+                # clients get explicit `error` responses and the failure
+                # is accounted — drain still returns the final stats
+                # (nothing admitted is ever silently lost).
+                import sys
+
+                print(f"serve drain worker failed: {e!r}", file=sys.stderr)
+                self._fail_inflight(e)
+        return self.stats
+
+    def report(self) -> dict:
+        """One JSON-able summary: stats + budget + executable accounting."""
+        return {
+            "stats": self.stats.summary(),
+            "budget": self.budget.summary(),
+            "budget_drops": self.budget.drops,
+            "budget_recoveries": self.budget.recoveries,
+            "executables": dict(self._fwd.stats),
+        }
+
+    def __enter__(self) -> "FlowServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.drain()

@@ -1,0 +1,129 @@
+"""Deterministic synthetic traffic: the open-loop stream the chaos tests
+and the ``serve_*`` bench row drive the server with.
+
+A schedule is fully determined by ``(seed, n_requests, interval_s,
+chaos)`` — same inputs, same frame pairs, same arrival offsets, same
+fault coordinates — so a failing chaos test replays exactly
+(``resilience/chaos.py``'s contract, extended to serving):
+
+- ``burst@N`` — request ``N`` arrives as a burst: ``burst_size``
+  requests due at the same instant (the overload that must produce
+  explicit sheds, not unbounded queueing).
+- ``poison@N`` — request ``N``'s first frame is all-NaN float32 (the
+  poison the dispatcher must quarantine away from its batch-mates).
+- ``sigterm@N`` — :func:`replay` delivers a real SIGTERM to the process
+  right after submitting ``N`` requests; with a
+  ``PreemptionHandler`` installed the driver stops submitting and the
+  server drains (the graceful-drain contract, mid-flight).
+
+Frames come from ``data/synthetic.SyntheticFlowDataset`` (content keyed
+on ``(seed, index)`` only), so traffic is cheap to generate on the
+submitting thread and identical across processes.
+"""
+
+from __future__ import annotations
+
+import os
+import signal as signal_mod
+import time
+from typing import Callable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from raft_ncup_tpu.data.synthetic import SyntheticFlowDataset
+from raft_ncup_tpu.resilience.chaos import ChaosSpec
+
+
+class SyntheticTraffic:
+    """Deterministic open-loop request schedule.
+
+    Iterating yields ``(due_s, image1, image2)`` tuples ordered by
+    ``due_s`` (seconds from stream start). ``interval_s`` is the steady
+    inter-arrival gap; a ``burst@N`` chaos event expands request ``N``
+    into ``burst_size`` simultaneous arrivals (all sharing N's due
+    time), modeling a thundering herd on top of the steady stream.
+    """
+
+    def __init__(
+        self,
+        size_hw: Tuple[int, int],
+        n_requests: int,
+        *,
+        seed: int = 0,
+        interval_s: float = 0.0,
+        burst_size: int = 8,
+        chaos: Optional[ChaosSpec] = None,
+        style: str = "smooth",
+    ):
+        self.size_hw = tuple(size_hw)
+        self.n_requests = int(n_requests)
+        self.interval_s = float(interval_s)
+        self.burst_size = max(1, int(burst_size))
+        self.chaos = chaos or ChaosSpec()
+        # Length covers the steady stream plus every burst expansion
+        # that actually fires (a burst@N with N past the stream's end
+        # never emits).
+        live_bursts = sum(
+            1 for i in self.chaos.burst_requests if i < self.n_requests
+        )
+        total = self.n_requests + live_bursts * (self.burst_size - 1)
+        self._ds = SyntheticFlowDataset(
+            self.size_hw, length=max(1, total), seed=seed, style=style
+        )
+        self._total = total
+
+    def __len__(self) -> int:
+        return self._total
+
+    def __iter__(self) -> Iterator[Tuple[float, np.ndarray, np.ndarray]]:
+        emitted = 0
+        for i in range(self.n_requests):
+            due = i * self.interval_s
+            copies = (
+                self.burst_size if i in self.chaos.burst_requests else 1
+            )
+            for _ in range(copies):
+                sample = self._ds.sample(emitted)
+                img1, img2 = sample["image1"], sample["image2"]
+                if i in self.chaos.poison_requests:
+                    img1 = np.full(img1.shape, np.nan, np.float32)
+                emitted += 1
+                yield due, img1, img2
+
+
+def replay(
+    server,
+    traffic: SyntheticTraffic,
+    *,
+    deadline_s: Optional[float] = None,
+    preempt=None,
+    sigterm_after: Optional[int] = None,
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+) -> Tuple[List, bool]:
+    """Drive ``server`` with ``traffic`` open-loop; returns
+    ``(handles, interrupted)``.
+
+    Open-loop means submissions happen at their due times regardless of
+    completions — the server's admission control, not the driver's
+    politeness, is what bounds the queue. ``preempt`` is an installed
+    ``resilience/preemption.PreemptionHandler``; once its flag is set
+    (e.g. by the ``sigterm_after`` self-signal, or an external SIGTERM)
+    the driver stops submitting immediately — the caller then invokes
+    ``server.drain()`` for the flush. ``interrupted`` reports whether
+    the stream was cut short that way.
+    """
+    handles: List = []
+    t0 = clock()
+    for due, img1, img2 in traffic:
+        if preempt is not None and preempt.requested:
+            return handles, True
+        delay = due - (clock() - t0)
+        if delay > 0:
+            sleep(delay)
+        handles.append(server.submit(img1, img2, deadline_s=deadline_s))
+        if sigterm_after is not None and len(handles) == sigterm_after:
+            # A REAL signal through the real handler (the chaos
+            # contract): the next loop iteration observes the flag.
+            os.kill(os.getpid(), signal_mod.SIGTERM)
+    return handles, bool(preempt is not None and preempt.requested)

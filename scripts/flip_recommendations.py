@@ -34,7 +34,7 @@ def recommend(record: dict) -> list[str]:
             "no accelerator measurement in this record "
             f"(baseline_key={key or 'absent'!r}); defaults stay "
             "corr_impl='volume', RAFT_NCUP_NCONV_IMPL='xla' pending TPU data"
-        ] + _val_row_lines(record)
+        ] + _val_row_lines(record) + _serve_row_lines(record)
 
     corr = {"volume": record.get("value")}
     for tag in ("onthefly", "pallas"):
@@ -97,6 +97,7 @@ def recommend(record: dict) -> list[str]:
         )
 
     lines.extend(_val_row_lines(record))
+    lines.extend(_serve_row_lines(record))
 
     nc = record.get("pairs_per_sec_nconv_pallas")
     fell_back = record.get("pairs_per_sec_nconv_pallas_FELL_BACK_TO_XLA")
@@ -175,6 +176,65 @@ def _val_row_lines(record: dict) -> list[str]:
         f"val_loop: no stall recovered on this host ({stall:.1f} ms/pair; "
         "saturated-host or accelerator-absent measurement) — pipeline "
         "stays on for the invariants; judge speed on accelerator rows"
+    ]
+
+
+def _serve_row_lines(record: dict) -> list[str]:
+    """Serving row (bench.py ``serve_*`` fields; docs/SERVING.md) — the
+    val-row policy applied to the serving tier: absent row → no lines
+    (older records predate it); nonzero guard counters → the latencies
+    measured a leaking/recompiling server and are unusable; a window
+    that shed or timed out → it measured backpressure, not service;
+    clean → the steady-state latency verdict the SLO reads."""
+    if record.get("serve_pairs_per_sec") is None:
+        return []
+    transfers = record.get("serve_host_transfers")
+    recompiles = record.get("serve_recompiles")
+    if transfers or recompiles:
+        return [
+            "serve: INVARIANT VIOLATED during the serving window "
+            f"({transfers or 0} implicit host transfer(s), "
+            f"{recompiles or 0} recompile(s)) — the serve_* latencies "
+            "measure a leaking or recompiling server; fix it "
+            "(docs/SERVING.md, docs/ANALYSIS.md) before reading them "
+            "as a service-time measurement"
+        ]
+    shed = record.get("serve_shed") or 0
+    timeouts = record.get("serve_timeouts") or 0
+    errors = record.get("serve_errors") or 0
+    drops = record.get("serve_budget_drops") or 0
+    if shed or timeouts:
+        return [
+            f"serve: window OVERLOADED ({shed} shed, {timeouts} "
+            "timeout(s)) — the serve_* numbers measured backpressure, "
+            "not steady-state service; lower the arrival rate or raise "
+            "capacity and rerun bench"
+        ]
+    if errors:
+        return [
+            f"serve: window ERRORED ({errors} request(s) failed "
+            "server-side) — the percentiles cover a partial sample; "
+            "fix the failure and rerun bench before reading them"
+        ]
+    p50 = record.get("serve_p50_ms")
+    p99 = record.get("serve_p99_ms")
+    if p50 is None or p99 is None:
+        return [
+            "serve: row incomplete (no latency percentiles); rerun "
+            "bench for the full serving row"
+        ]
+    degr = (
+        f"; budget degraded {drops}x during the window (arrival rate "
+        "sits near capacity — p99 includes coarser-flow responses)"
+        if drops else "; budget never degraded (full-quality responses)"
+    )
+    n_ok = record.get("serve_ok", record.get("serve_requests", "?"))
+    return [
+        f"serve: steady state {record['serve_pairs_per_sec']:.2f} "
+        f"pairs/s, p50 {p50:.1f} ms / p99 {p99:.1f} ms at "
+        f"{record.get('serve_iters', '?')} iters over "
+        f"{n_ok} requests "
+        f"(invariants clean){degr}"
     ]
 
 

@@ -7,5 +7,5 @@ set -e
 cd "$(dirname "$0")/.."
 exec python -m raft_ncup_tpu.analysis \
     --strict-allowlist \
-    raft_ncup_tpu/ train.py evaluate.py demo.py bench.py scripts/ \
+    raft_ncup_tpu/ train.py evaluate.py demo.py serve.py bench.py scripts/ \
     "$@"

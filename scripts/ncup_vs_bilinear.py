@@ -18,6 +18,12 @@ strongest data-free version of that test (VERDICT r4 #2):
    upsampled) is bit-identical between the twins, so any delta is
    attributable to the upsampler alone.
 
+Error bars (ROADMAP carry-over): the held-out evaluation runs once per
+split seed (``--eval_seeds``, default 3 seeds), giving per-seed
+boundary-band deltas, and :func:`bootstrap_ci` puts a percentile
+bootstrap CI on their mean — the quality claim ships with its
+uncertainty instead of a single draw of the synthetic split.
+
 Re-runnable: finished stages are skipped (presence of the final
 checkpoint step), so a crashed run resumes where it left off.
 Emits docs/ncup_vs_bilinear.json and a markdown table on stdout.
@@ -31,9 +37,41 @@ import os
 import subprocess
 import sys
 
+import numpy as np
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
+
+
+def bootstrap_ci(
+    values: list[float],
+    n_resamples: int = 10_000,
+    seed: int = 0,
+    alpha: float = 0.05,
+) -> dict:
+    """Percentile bootstrap CI for the mean of ``values``.
+
+    Deterministic given ``seed``. With few seeds (the 3-seed default)
+    the interval is coarse by construction — it honestly reflects how
+    little the seed dimension has been sampled, which is the point:
+    a claim whose CI straddles zero hasn't been established.
+    """
+    vals = np.asarray(values, np.float64)
+    if vals.size == 0:
+        raise ValueError("bootstrap_ci needs at least one value")
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, vals.size, size=(int(n_resamples), vals.size))
+    means = vals[idx].mean(axis=1)
+    lo, hi = np.quantile(means, [alpha / 2.0, 1.0 - alpha / 2.0])
+    return {
+        "mean": float(vals.mean()),
+        "ci_lo": float(lo),
+        "ci_hi": float(hi),
+        "alpha": alpha,
+        "n_values": int(vals.size),
+        "n_resamples": int(n_resamples),
+    }
 
 
 def sh(args: list[str]) -> None:
@@ -82,8 +120,15 @@ def main() -> None:
     p.add_argument("--ncup_name", default="rigid_ncup")
     p.add_argument("--val_length", type=int, default=64,
                    help="held-out pairs per evaluation")
+    p.add_argument("--eval_seeds", default="999,1000,1001",
+                   help="comma-joined held-out split seeds; both twins "
+                   "are evaluated once per seed and the boundary-band "
+                   "delta gets a bootstrap CI over the per-seed values")
     p.add_argument("--out", default="docs/ncup_vs_bilinear.json")
     a = p.parse_args()
+    eval_seeds = [int(s) for s in a.eval_seeds.split(",") if s.strip()]
+    if not eval_seeds:
+        p.error("--eval_seeds must name at least one seed")
 
     # train.py subprocesses run with cwd=REPO, so relative paths must be
     # anchored there too or skip-checks look in the caller's cwd.
@@ -111,9 +156,10 @@ def main() -> None:
 
     eval_kw = dict(iters=12, batch_size=4, size_hw=(96, 128),
                    length=a.val_length)
-    results: dict[str, dict] = {}
+    # results[twin][seed] -> metric dict; twin variables load ONCE.
+    results: dict[str, dict[int, dict]] = {}
 
-    def eval_twin(twin: str) -> dict:
+    def twin_variables(twin: str):
         _, model_cfg, _, _ = parse_train(train_argv(a, twin))
         model = get_model(model_cfg)
         if twin == "ncup":
@@ -122,26 +168,54 @@ def main() -> None:
             # Parameter-free head: the frozen trunk IS the whole model.
             variables = model.init(jax.random.PRNGKey(0), (1, 64, 96, 3))
             variables = load_pretrained_trunk(trunk_dir, variables)
-        return validate_synthetic_rigid(model, variables, **eval_kw)
+        return model, variables
 
     for twin in ("bilinear", "ncup"):
-        print(f"== evaluating twin: {twin}", flush=True)
-        results[twin] = eval_twin(twin)
+        model, variables = twin_variables(twin)
+        results[twin] = {}
+        for es in eval_seeds:
+            print(f"== evaluating twin: {twin} (split seed {es})",
+                  flush=True)
+            results[twin][es] = validate_synthetic_rigid(
+                model, variables, seed=es, **eval_kw
+            )
 
-    delta = {
-        k.replace("synthetic_rigid", "delta"): (
-            results["bilinear"][k] - results["ncup"][k]
-        )
-        for k in results["ncup"]
+    # Per-seed deltas (bilinear - ncup; positive = NCUP wins) and the
+    # bootstrap CI over the seed dimension for each metric.
+    per_seed_delta = {
+        k.replace("synthetic_rigid", "delta"): [
+            results["bilinear"][es][k] - results["ncup"][es][k]
+            for es in eval_seeds
+        ]
+        for k in results["ncup"][eval_seeds[0]]
+    }
+    ci = {k: bootstrap_ci(v, seed=a.seed)
+          for k, v in per_seed_delta.items()}
+    # Seed-pooled means keep the pre-CI record fields comparable.
+    mean = {
+        twin: {
+            k: float(np.mean([results[twin][es][k] for es in eval_seeds]))
+            for k in results[twin][eval_seeds[0]]
+        }
+        for twin in results
     }
     record = {
         "experiment": "ncup_vs_bilinear",
         "trunk": {"dir": trunk_dir, "steps": a.trunk_steps},
         "ncup_steps": a.ncup_steps,
         "seed": a.seed,
-        "eval": {"split": "synthetic_rigid(seed=999)", **eval_kw},
-        "results": results,
-        "bilinear_minus_ncup": delta,
+        "eval": {
+            "split": f"synthetic_rigid(seeds={eval_seeds})",
+            "seeds": eval_seeds,
+            **eval_kw,
+        },
+        "results": mean,
+        "results_per_seed": {
+            t: {str(es): r for es, r in results[t].items()} for t in results
+        },
+        "bilinear_minus_ncup": {k: v["mean"] for k, v in ci.items()},
+        "bilinear_minus_ncup_per_seed": per_seed_delta,
+        "bootstrap_ci": ci,
     }
     os.makedirs(os.path.dirname(os.path.join(REPO, a.out)), exist_ok=True)
     with open(os.path.join(REPO, a.out), "w") as f:
@@ -149,10 +223,11 @@ def main() -> None:
     print(json.dumps(record["bilinear_minus_ncup"]))
 
     rows = [
-        ("bilinear (frozen trunk)", results["bilinear"]),
-        ("NCUP (trained on frozen trunk)", results["ncup"]),
+        ("bilinear (frozen trunk)", mean["bilinear"]),
+        ("NCUP (trained on frozen trunk)", mean["ncup"]),
     ]
-    print("\n| upsampler | EPE | boundary EPE | interior EPE |")
+    print(f"\n(means over {len(eval_seeds)} held-out split seeds)")
+    print("| upsampler | EPE | boundary EPE | interior EPE |")
     print("|---|---|---|---|")
     for name, r in rows:
         print(
@@ -160,7 +235,15 @@ def main() -> None:
             f"| {r['synthetic_rigid_bnd']:.3f} "
             f"| {r['synthetic_rigid_interior']:.3f} |"
         )
-    print(f"\nrecord written to {a.out}")
+    bnd = ci["delta_bnd"]
+    print(
+        f"\nboundary-band delta (bilinear - ncup): {bnd['mean']:.4f} "
+        f"[{bnd['ci_lo']:.4f}, {bnd['ci_hi']:.4f}] "
+        f"({100 * (1 - bnd['alpha']):.0f}% bootstrap CI over "
+        f"{bnd['n_values']} seeds; claim established only if the "
+        "interval excludes 0)"
+    )
+    print(f"record written to {a.out}")
 
 
 if __name__ == "__main__":

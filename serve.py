@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Flow-serving driver: run the online serving tier against a
+deterministic synthetic open-loop request stream.
+
+The serving analogue of train.py/evaluate.py (no reference counterpart —
+the reference has no serving story). Builds one model + variables set,
+stands up a :class:`raft_ncup_tpu.serving.FlowServer` (bounded admission
+queue, anytime iteration budget, poison quarantine), warms the full
+executable set, replays ``--num_requests`` synthetic requests at
+``--interval_ms``, then drains and prints ONE JSON report line
+(stats + latency percentiles + budget trajectory).
+
+Graceful drain: SIGTERM/SIGINT (via ``resilience/preemption.py``) stops
+submissions immediately, every request already admitted is flushed
+through compute, and the process exits ``EXIT_PREEMPTED`` (75) — the
+clean re-runnable shutdown, distinct from success and crash. Chaos
+events (``--chaos "burst@8,poison@20,sigterm@40"``) drive the same
+machinery deterministically (docs/SERVING.md has the full matrix).
+
+Examples:
+    python serve.py --platform cpu --num_requests 32 --size 96 128 \
+        --iter_levels 12,6 --serve_batch_sizes 1,2
+    python serve.py --restore_ckpt checkpoints/raft_nc_sintel \
+        --chaos "burst@16" --queue_capacity 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from raft_ncup_tpu.cli import (
+        add_model_args,
+        add_platform_arg,
+        add_serve_args,
+    )
+
+    parser = argparse.ArgumentParser(
+        description="Serve RAFT / RAFT-NCUP flow over a synthetic "
+        "open-loop request stream"
+    )
+    parser.add_argument("--restore_ckpt", default=None,
+                        help="orbax run dir or torch .pth (default: "
+                        "randomly initialized weights — the serving "
+                        "machinery is shape-, not weight-, dependent)")
+    parser.add_argument("--num_requests", type=int, default=32)
+    parser.add_argument("--interval_ms", type=float, default=0.0,
+                        help="steady inter-arrival gap (0 = as fast as "
+                        "the submitting thread can go)")
+    parser.add_argument("--size", type=int, nargs=2, default=[96, 128],
+                        metavar=("H", "W"), help="request frame size")
+    parser.add_argument("--burst_size", type=int, default=8,
+                        help="requests per burst@N chaos event")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--style", default="smooth",
+                        choices=["smooth", "rigid"],
+                        help="synthetic traffic content generator")
+    parser.add_argument("--chaos", default=None,
+                        help="deterministic serving faults: comma-joined "
+                        "burst@N / poison@N / sigterm@N "
+                        "(resilience/chaos.py)")
+    add_serve_args(parser)
+    add_model_args(parser)
+    add_platform_arg(parser)
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from raft_ncup_tpu.cli import apply_platform
+
+    apply_platform(args)
+
+    from evaluate import load_variables
+    from raft_ncup_tpu.cli import model_config_from_args, serve_config_from_args
+    from raft_ncup_tpu.models.raft import RAFT
+    from raft_ncup_tpu.resilience import EXIT_PREEMPTED, PreemptionHandler
+    from raft_ncup_tpu.resilience.chaos import ChaosSpec
+    from raft_ncup_tpu.serving import (
+        FlowServer,
+        SyntheticTraffic,
+        nearest_rank_ms,
+        replay,
+    )
+
+    model_cfg = model_config_from_args(args)
+    serve_cfg = serve_config_from_args(args)
+    chaos = ChaosSpec.parse(args.chaos)
+    if chaos.active:
+        print(f"chaos: {chaos.render()}", file=sys.stderr)
+
+    model = RAFT(model_cfg)
+    variables = load_variables(model, model_cfg, args.restore_ckpt)
+    size_hw = (args.size[0], args.size[1])
+
+    server = FlowServer(model, variables, serve_cfg)
+    t0 = time.monotonic()
+    compiled = server.warmup(size_hw)
+    print(
+        f"warmup: {compiled} executables compiled in "
+        f"{time.monotonic() - t0:.1f}s "
+        f"(batch_sizes={serve_cfg.batch_sizes} "
+        f"iter_levels={serve_cfg.iter_levels})",
+        file=sys.stderr,
+    )
+
+    traffic = SyntheticTraffic(
+        size_hw,
+        args.num_requests,
+        seed=args.seed,
+        interval_s=args.interval_ms / 1000.0,
+        burst_size=args.burst_size,
+        chaos=chaos,
+        style=args.style,
+    )
+    t0 = time.monotonic()
+    with PreemptionHandler() as preempt:
+        handles, interrupted = replay(
+            server, traffic, preempt=preempt,
+            sigterm_after=chaos.sigterm_after,
+        )
+        stats = server.drain()
+    wall = time.monotonic() - t0
+
+    responses = [h.result(timeout=30.0) for h in handles]
+    lat = [
+        r.latency_s for r in responses if r.ok and r.latency_s is not None
+    ]
+
+    report = {
+        "serve_requests": len(handles),
+        "serve_ok": len(lat),
+        "serve_wall_s": round(wall, 3),
+        "serve_pairs_per_sec": (
+            round(stats.completed / wall, 3) if wall > 0 else None
+        ),
+        "serve_p50_ms": nearest_rank_ms(lat, 0.50),
+        "serve_p99_ms": nearest_rank_ms(lat, 0.99),
+        "interrupted": interrupted,
+        "completed": stats.completed,
+        "shed": stats.shed,
+        "timeouts": stats.timeouts,
+        "rejected": stats.rejected,
+        "errors": stats.errors,
+        **server.report(),
+    }
+    print(json.dumps(report), flush=True)
+    if interrupted:
+        print(
+            "serve: drained after signal — everything admitted was "
+            "flushed; exiting EXIT_PREEMPTED",
+            file=sys.stderr,
+        )
+        return EXIT_PREEMPTED
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -156,6 +156,72 @@ class TestValRow:
         assert any("val_loop: INVARIANT VIOLATED" in l for l in lines)
 
 
+class TestServeRow:
+    """Serving row handling (bench.py serve_* fields; docs/SERVING.md):
+    absent row → silent; guard counters nonzero → unusable; an
+    overloaded window → backpressure, not service; clean → the latency
+    verdict with the degradation note."""
+
+    def _serve(self, **kw):
+        base = dict(
+            serve_pairs_per_sec=8.5, serve_p50_ms=115.0,
+            serve_p99_ms=140.0, serve_requests=16, serve_iters=12,
+            serve_shed=0, serve_timeouts=0, serve_budget_drops=0,
+            serve_recompiles=0, serve_host_transfers=0,
+        )
+        base.update(kw)
+        return base
+
+    def test_absent_serve_row_adds_no_lines(self):
+        lines = flip.recommend(_tpu())
+        assert not any("serve" in l for l in lines)
+
+    def test_violated_invariants_flag_row_unusable(self):
+        lines = flip.recommend(
+            _tpu(**self._serve(serve_recompiles=2,
+                               serve_host_transfers=1))
+        )
+        joined = "\n".join(lines)
+        assert "serve: INVARIANT VIOLATED" in joined
+        assert "2 recompile(s)" in joined
+        assert "1 implicit host transfer(s)" in joined
+        assert "p50" not in joined  # unusable latencies never reported
+
+    def test_overloaded_window_flagged_not_reported(self):
+        lines = flip.recommend(_tpu(**self._serve(serve_shed=3)))
+        joined = "\n".join(lines)
+        assert "serve: window OVERLOADED" in joined
+        assert "3 shed" in joined
+        assert "p50" not in joined
+
+    def test_errored_window_flagged_partial_sample(self):
+        lines = flip.recommend(_tpu(**self._serve(serve_errors=1)))
+        joined = "\n".join(lines)
+        assert "serve: window ERRORED" in joined
+        assert "partial sample" in joined
+        assert "p50" not in joined
+
+    def test_clean_row_reports_latency_verdict(self):
+        lines = flip.recommend(_tpu(**self._serve()))
+        joined = "\n".join(lines)
+        assert "serve: steady state 8.50 pairs/s" in joined
+        assert "p50 115.0 ms / p99 140.0 ms at 12 iters" in joined
+        assert "budget never degraded" in joined
+
+    def test_clean_row_with_degradation_notes_it(self):
+        lines = flip.recommend(
+            _tpu(**self._serve(serve_budget_drops=2))
+        )
+        assert any("budget degraded 2x" in l for l in lines)
+
+    def test_serve_row_reported_even_on_cpu_records(self):
+        lines = flip.recommend(
+            {"value": 9.0, "baseline_key": "cpu@h:volume:x",
+             **self._serve()}
+        )
+        assert any("serve: steady state" in l for l in lines)
+
+
 class TestMain:
     def _run(self, capsys, monkeypatch, text):
         import io

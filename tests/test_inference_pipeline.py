@@ -431,6 +431,48 @@ class TestDeviceMetricParity:
         assert k == {"epe": 2.0, "f1": 10.0}
 
 
+class TestKittiEmptyValidMask:
+    """ROADMAP carry-over regression: a frame with ZERO valid pixels made
+    the host path's per-frame EPE mean NaN (0-valid sum / 0 count) and
+    poisoned the dataset mean; with nothing valid pooled at all,
+    ``finalize``'s ``acc[2]/acc[3]`` divided 0/0. Empty frames now
+    contribute neither EPE nor frame count; degenerate pools finalize to
+    0.0, never NaN."""
+
+    def _acc(self, valid: np.ndarray) -> np.ndarray:
+        g = np.random.default_rng(5)
+        b, h, w = valid.shape
+        flow_up = jnp.asarray(g.normal(size=(b, h, w, 2)).astype(np.float32))
+        gt = jnp.asarray(g.normal(size=(b, h, w, 2)).astype(np.float32))
+        acc = metrics_mod.accumulate(
+            "kitti", metrics_mod.init_acc("kitti"), flow_up, gt,
+            valid=jnp.asarray(valid),
+        )
+        self._flow_up, self._gt = np.asarray(flow_up), np.asarray(gt)
+        return np.asarray(jax.device_get(acc))
+
+    def test_all_invalid_frame_excluded_not_nan(self):
+        valid = np.ones((2, 8, 10), np.float32)
+        valid[1] = 0.0  # frame 1: zero valid pixels
+        acc = self._acc(valid)
+        assert np.isfinite(acc).all()
+        # The empty frame contributes neither EPE nor frame count, so
+        # the remaining frame's mean is undiluted.
+        epe0 = np.sqrt(
+            ((self._flow_up[0] - self._gt[0]) ** 2).sum(-1)
+        )
+        assert acc[1] == 1.0
+        np.testing.assert_allclose(acc[0], epe0.mean(), rtol=1e-5)
+        m = metrics_mod.finalize("kitti", acc)
+        np.testing.assert_allclose(m["epe"], epe0.mean(), rtol=1e-5)
+        assert np.isfinite(m["f1"])
+
+    def test_every_frame_invalid_finalizes_to_zero(self):
+        acc = self._acc(np.zeros((2, 8, 10), np.float32))
+        assert np.isfinite(acc).all() and acc[1] == 0.0
+        assert metrics_mod.finalize("kitti", acc) == {"epe": 0.0, "f1": 0.0}
+
+
 class TestEvalLoopInvariants:
     """N eval batches under forbid_host_transfers + max_recompiles: only
     the sanctioned window pull touches the host, and the warm loop never

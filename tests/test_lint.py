@@ -527,6 +527,27 @@ def test_jgl008_negative_window_pull_throttle_and_nested_def(tmp_path):
     assert findings == []
 
 
+def test_jgl008_serving_dispatcher_in_scope(tmp_path):
+    """The serving dispatcher is the same hot loop facing an open-loop
+    stream: a per-batch pull on the dispatch thread re-serializes every
+    batch with d2h transfer — the AsyncDrain worker owns the pull."""
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def dispatch(queue, fwd):
+            while queue:
+                batch = queue.pop()
+                flow = fwd(batch)
+                return_to_client(jax.device_get(flow))
+        """,
+        name="raft_ncup_tpu/serving/server.py",
+    )
+    assert [f.rule for f in findings] == ["JGL008"]
+    assert findings[0].qualname == "dispatch"
+
+
 def test_jgl008_out_of_scope_paths_exempt(tmp_path):
     """The same per-iteration pull outside inference//evaluation.py is
     JGL001's business (when traced) or legitimate driver code."""
@@ -649,7 +670,7 @@ def test_drivers_and_scripts_lint_clean():
         os.path.join(REPO, p)
         for p in (
             "raft_ncup_tpu", "train.py", "evaluate.py", "demo.py",
-            "bench.py", "scripts",
+            "serve.py", "bench.py", "scripts",
         )
     ]
     result = run_lint(paths, allowlist_path=DEFAULT_ALLOWLIST)

@@ -1,0 +1,90 @@
+"""The quality-proxy driver's statistics (scripts/ncup_vs_bilinear.py):
+the bootstrap CI that puts error bars on the NCUP-vs-bilinear
+boundary-band delta must be deterministic, correctly ordered, and honest
+about degenerate inputs — the one short window in which the twin
+experiment reruns must not hit a regressed estimator.
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "ncup_vs_bilinear",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "ncup_vs_bilinear.py",
+    ),
+)
+nvb = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(nvb)
+
+
+class TestBootstrapCI:
+    def test_deterministic_given_seed(self):
+        # (With 3 values the resampled-mean distribution is discrete, so
+        # DIFFERENT seeds may also coincide — only same-seed equality is
+        # part of the contract.)
+        vals = [0.09, 0.11, 0.07]
+        a = nvb.bootstrap_ci(vals, seed=4)
+        b = nvb.bootstrap_ci(vals, seed=4)
+        assert a == b
+
+    def test_interval_brackets_mean_and_data(self):
+        vals = [0.05, 0.10, 0.15]
+        ci = nvb.bootstrap_ci(vals, seed=0)
+        assert ci["ci_lo"] <= ci["mean"] <= ci["ci_hi"]
+        assert ci["mean"] == pytest.approx(0.10)
+        # Resampled means live inside the data's range.
+        assert min(vals) <= ci["ci_lo"] and ci["ci_hi"] <= max(vals)
+        assert ci["n_values"] == 3
+
+    def test_identical_values_collapse_the_interval(self):
+        ci = nvb.bootstrap_ci([0.2, 0.2, 0.2], seed=0)
+        assert ci["ci_lo"] == ci["ci_hi"] == pytest.approx(0.2)
+
+    def test_sign_uncertain_claim_straddles_zero(self):
+        """The case the satellite exists for: per-seed deltas of mixed
+        sign must yield an interval containing 0 — a claim the record
+        cannot call established."""
+        ci = nvb.bootstrap_ci([0.10, -0.08, 0.02], seed=1)
+        assert ci["ci_lo"] < 0.0 < ci["ci_hi"]
+
+    def test_empty_input_raises(self):
+        with pytest.raises(ValueError, match="at least one"):
+            nvb.bootstrap_ci([])
+
+    def test_wider_alpha_narrows_interval(self):
+        vals = list(np.random.default_rng(0).normal(0.1, 0.05, 5))
+        wide = nvb.bootstrap_ci(vals, seed=2, alpha=0.05)
+        narrow = nvb.bootstrap_ci(vals, seed=2, alpha=0.5)
+        assert narrow["ci_lo"] >= wide["ci_lo"]
+        assert narrow["ci_hi"] <= wide["ci_hi"]
+
+
+class TestSeedPlumbing:
+    def test_validate_synthetic_passes_seed_to_dataset(self, monkeypatch):
+        """The multi-seed CI is only as real as the splits are distinct:
+        validate_synthetic(seed=N) must construct its held-out dataset
+        with seed=N, not a hardcoded historical value (regression — the
+        three --eval_seeds runs were silently identical)."""
+        from raft_ncup_tpu import evaluation
+        from raft_ncup_tpu.data import synthetic as synth_mod
+
+        captured = {}
+
+        class _Probe:
+            def __init__(self, size_hw, length=0, seed=None, style=None):
+                captured["seed"] = seed
+
+            def __len__(self):
+                return 0  # trips the empty-after-sharding skip path
+
+        monkeypatch.setattr(synth_mod, "SyntheticFlowDataset", _Probe)
+        out = evaluation.validate_synthetic(
+            None, None, None, size_hw=(16, 24), seed=1234,
+        )
+        assert out == {}
+        assert captured["seed"] == 1234
