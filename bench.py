@@ -370,6 +370,23 @@ def _child_main() -> None:
         except Exception as e:  # never lose the earlier rows
             print(f"serve bench failed: {e}", file=sys.stderr)
 
+    # Streaming row (docs/STREAMING.md; docs/PERF.md "Streaming"):
+    # steady-state multi-stream video through the StreamEngine — slot
+    # gather, in-graph warm-start splat, batched forward, anomaly check,
+    # scatter, AsyncDrain pull — under the same guards. The warm slot
+    # table and fixed per-batch-size executable set are the recompile-
+    # free contract: `stream_recompiles`/`stream_host_transfers` must be
+    # 0. BENCH_SKIP_STREAM=1 turns it off explicitly.
+    if os.environ.get("BENCH_SKIP_STREAM") == "1":
+        pass
+    elif child_budget - (time.monotonic() - t0) > 0.08 * child_budget:
+        try:
+            record.update(_measure_stream(shape, mixed_precision,
+                                          corr_impl, variables))
+            _emit(record)
+        except Exception as e:  # never lose the earlier rows
+            print(f"stream bench failed: {e}", file=sys.stderr)
+
 
 def _measure_train_step(
     shape: dict, mixed_precision: bool, corr_impl: str
@@ -819,6 +836,127 @@ def _measure_serve(
         "serve_budget_drops": server.budget.drops,
         "serve_recompiles": wd.count,
         "serve_host_transfers": stats.host_transfers,
+    }
+
+
+def _measure_stream(
+    shape: dict, mixed_precision: bool, corr_impl: str, variables: dict,
+    n_frames: int | None = None,
+) -> dict:
+    """Steady-state multi-stream video throughput through the
+    StreamEngine (streaming/engine.py; docs/STREAMING.md).
+
+    The window multiplexes ``BENCH_STREAM_STREAMS`` (default 4)
+    concurrent synthetic streams into the batched warm-start step and
+    measures frames/sec plus per-frame submit→complete latency. Like
+    the serve row it is open-loop and deliberately under capacity
+    (arrivals at ~1.3x the calibrated per-frame service time) — the
+    admission/eviction/anomaly behaviors are pinned functionally by
+    tests/test_streaming.py, not timed here.
+
+    Guards: ``stream_recompiles`` counts XLA compiles after warmup
+    compiled the per-batch-size step set (must be 0 — slot reuse,
+    cold/warm transitions, and anomaly resets all ride the SAME
+    executables); ``stream_host_transfers`` counts implicit d2h pulls
+    (must be 0 — each batch's flow+flags pull is the sanctioned
+    explicit ``jax.device_get`` in the AsyncDrain worker; the
+    warm-start chain itself never leaves the device).
+    ``stream_shed``/``stream_errors``/``stream_resets`` must be 0 here:
+    a window that shed measured backpressure and a window that reset
+    measured anomaly handling, not service. Slot-table occupancy stats
+    (mean/peak over dispatched batches) land in the record so a future
+    capacity flip has data. BENCH_STRICT_GUARDS=1 makes guard
+    violations raise.
+    """
+    from raft_ncup_tpu.analysis.guards import (
+        GuardStats,
+        RecompileWatchdog,
+        forbid_host_transfers,
+    )
+    from raft_ncup_tpu.config import StreamConfig, flagship_config
+    from raft_ncup_tpu.models.raft import get_model
+    from raft_ncup_tpu.serving import nearest_rank_ms
+    from raft_ncup_tpu.streaming import (
+        StreamEngine,
+        StreamTraffic,
+        replay_streams,
+    )
+
+    B, H, W = shape["batch"], shape["height"], shape["width"]
+    iters = shape["iters"]
+    n_streams = int(os.environ.get("BENCH_STREAM_STREAMS", "4"))
+    frames = n_frames or int(os.environ.get("BENCH_STREAM_FRAMES", "6"))
+    strict = os.environ.get("BENCH_STRICT_GUARDS") == "1"
+
+    cfg = StreamConfig(
+        capacity=n_streams,
+        frame_hw=(H, W),
+        iters=iters,
+        batch_sizes=(1, 2, 4),
+        queue_capacity=max(8, n_streams * frames),
+    )
+    model = get_model(
+        flagship_config(
+            dataset="sintel", mixed_precision=mixed_precision,
+            corr_impl=corr_impl,
+        )
+    )
+    engine = StreamEngine(model, variables, cfg)
+    try:
+        engine.warmup()
+        # Calibrate per-frame service time on the warm executables.
+        calib = StreamTraffic((H, W), 1, 2, seed=92, style="rigid")
+        t0 = time.perf_counter()
+        for h in replay_streams(engine, calib)[0]:
+            h.result(timeout=120.0)
+        per_frame = (time.perf_counter() - t0) / 2.0
+        interval = per_frame * 1.3
+        # Free the calibration stream's slot (and its frame-index
+        # history) so the measured window's "stream-0" admits fresh.
+        engine.close_stream(calib.stream_id(0))
+
+        stats = GuardStats()
+        with RecompileWatchdog() as wd, forbid_host_transfers(
+            stats, raise_on_violation=strict
+        ):
+            traffic = StreamTraffic(
+                (H, W), n_streams, frames, seed=93,
+                interval_s=interval, style="rigid",
+            )
+            t0 = time.perf_counter()
+            handles, _ = replay_streams(engine, traffic)
+            responses = [h.result(timeout=120.0) for h in handles]
+            dt = time.perf_counter() - t0
+        report = engine.report()
+    finally:
+        engine.drain()
+
+    lat = [
+        r.latency_s for r in responses if r.ok and r.latency_s is not None
+    ]
+    sstats = engine.stats
+    if not lat:
+        raise RuntimeError(
+            f"no ok responses in stream window: {sstats.summary()}"
+        )
+    return {
+        "stream_frames_per_sec": round(len(lat) / dt, 4) if dt > 0 else 0.0,
+        "stream_p50_ms": nearest_rank_ms(lat, 0.50),
+        "stream_p99_ms": nearest_rank_ms(lat, 0.99),
+        "stream_frames": len(handles),
+        "stream_ok": len(lat),
+        "stream_streams": n_streams,
+        "stream_interval_ms": round(interval * 1e3, 1),
+        "stream_iters": iters,
+        "stream_shed": sstats.shed_streams + sstats.shed_frames,
+        "stream_resets": sstats.resets,
+        "stream_errors": sstats.errors,
+        "stream_evicted": sstats.streams_evicted,
+        "stream_occupancy_mean": report["mean_occupancy"],
+        "stream_occupancy_peak": report["peak_occupancy"],
+        "stream_capacity": n_streams,
+        "stream_recompiles": wd.count,
+        "stream_host_transfers": stats.host_transfers,
     }
 
 

@@ -12,15 +12,20 @@ full flow fields per batch, ~4.4 MB/pair at 368x768).
 Scoped to ``raft_ncup_tpu/inference/``, ``raft_ncup_tpu/serving/`` (the
 serving dispatcher is the same hot loop facing an open-loop stream: its
 per-batch result pull must ride the AsyncDrain worker, never the
-dispatch thread) and ``evaluation.py``. Flags the
+dispatch thread), ``raft_ncup_tpu/streaming/`` (the stream dispatcher
+batches stateful frames: per-stream recurrent state lives in the device
+slot table precisely so that NOTHING needs pulling between frames) and
+``evaluation.py``. Flags the
 pull calls only when they execute per loop iteration (``for``/``while``
 bodies and comprehensions); a function merely *defined* inside a loop is
 not flagged at its definition site. ``jax.block_until_ready`` is
 deliberately NOT flagged: it is a sync without a transfer — the
 DispatchThrottle's bounded in-flight wait is part of the sanctioned
-steady state. Audited exceptions (the AsyncDrain worker, which IS the
-sanctioned off-dispatch pull; the Sintel warm-start's inherent serial
-low-res pull) go through the allowlist with justifications.
+steady state. The one audited exception is the AsyncDrain worker, which
+IS the sanctioned off-dispatch pull. (The Sintel warm-start's serial
+low-res pull — the second historical entry — was deleted when the
+forward splat moved on device: ``ops/warmstart.forward_interpolate_jax``
+keeps the warm chain in HBM, so there is nothing left to pull.)
 """
 
 from __future__ import annotations
@@ -63,6 +68,8 @@ def _in_scope(path: str) -> bool:
         or p.startswith("inference/")
         or "/serving/" in p
         or p.startswith("serving/")
+        or "/streaming/" in p
+        or p.startswith("streaming/")
         or p.endswith("/evaluation.py")
         or p == "evaluation.py"
     )

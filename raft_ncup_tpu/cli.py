@@ -24,6 +24,7 @@ from raft_ncup_tpu.config import (
     DataConfig,
     ModelConfig,
     ServeConfig,
+    StreamConfig,
     TrainConfig,
     UpsamplerConfig,
 )
@@ -219,6 +220,63 @@ def serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
     )
 
 
+def add_stream_args(parser: argparse.ArgumentParser) -> None:
+    """Streaming-engine knobs (StreamConfig; raft_ncup_tpu/streaming/,
+    docs/STREAMING.md)."""
+    d = StreamConfig()
+    parser.add_argument("--stream_capacity", type=int, default=d.capacity,
+                        help="slot-table size = concurrent-stream bound; "
+                        "admission beyond it sheds with a retry hint")
+    parser.add_argument("--stream_batch_sizes", type=str2ints,
+                        default=d.batch_sizes,
+                        help="allowed step programs, ascending (e.g. "
+                        "'1,2,4'); one executable per size, compiled at "
+                        "warmup")
+    parser.add_argument("--stream_iters", type=int, default=d.iters,
+                        help="fixed GRU iterations per frame")
+    parser.add_argument("--stream_queue_capacity", type=int,
+                        default=d.queue_capacity,
+                        help="bounded frame admission queue (frames, "
+                        "across all streams)")
+    parser.add_argument("--max_frame_gap", type=int, default=d.max_frame_gap,
+                        help="frame-index gap beyond which warm state is "
+                        "stale and the frame cold-starts")
+    parser.add_argument("--idle_timeout_s", type=float,
+                        default=d.idle_timeout_s,
+                        help="idle/abandoned streams lose their slot "
+                        "after this long with nothing in flight")
+    parser.add_argument("--carry_net", type=str2bool, nargs="?",
+                        const=True, default=d.carry_net,
+                        help="also carry the GRU hidden state across "
+                        "frames (extension beyond the reference's "
+                        "flow-only warm start)")
+    parser.add_argument("--anomaly_max_flow", type=float,
+                        default=d.anomaly_max_flow,
+                        help="in-graph divergence bound: low-res flow "
+                        "beyond this resets the stream to cold start")
+    parser.add_argument("--stream_pad_bucket", type=int,
+                        default=d.pad_bucket,
+                        help="round padded frame shapes up to multiples "
+                        "of this bucket (0=off)")
+
+
+def stream_config_from_args(
+    args: argparse.Namespace, frame_hw: tuple[int, int]
+) -> StreamConfig:
+    return StreamConfig(
+        capacity=args.stream_capacity,
+        frame_hw=tuple(frame_hw),
+        pad_bucket=args.stream_pad_bucket,
+        iters=args.stream_iters,
+        batch_sizes=tuple(args.stream_batch_sizes),
+        queue_capacity=args.stream_queue_capacity,
+        max_frame_gap=args.max_frame_gap,
+        idle_timeout_s=args.idle_timeout_s,
+        carry_net=args.carry_net,
+        anomaly_max_flow=args.anomaly_max_flow,
+    )
+
+
 def add_train_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--name", default="raft")
     parser.add_argument("--stage", required=True,
@@ -404,10 +462,15 @@ def build_eval_parser() -> argparse.ArgumentParser:
     parser.add_argument("--restore_ckpt", default=None,
                         help="orbax run dir or torch .pth")
     parser.add_argument("--dataset", required=True,
-                        choices=["chairs", "sintel", "kitti"])
+                        choices=["chairs", "sintel", "sintel_warm",
+                                 "kitti"])
     parser.add_argument("--submission", action="store_true",
                         help="write leaderboard files instead of validating")
-    parser.add_argument("--warm_start", action="store_true")
+    parser.add_argument("--warm_start", action="store_true",
+                        help="submission: warm-start each Sintel "
+                        "sequence from the previous frame's device "
+                        "forward-splat (validator analogue: --dataset "
+                        "sintel_warm)")
     parser.add_argument("--write_png", action="store_true")
     parser.add_argument("--output_path", default=None)
     parser.add_argument("--export_pth", default=None, metavar="PATH",

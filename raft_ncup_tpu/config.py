@@ -294,6 +294,87 @@ class ServeConfig:
         return self.batch_sizes[-1]
 
 
+@dataclass(frozen=True)
+class StreamConfig:
+    """Streaming video engine knobs (raft_ncup_tpu/streaming/;
+    docs/STREAMING.md).
+
+    One engine serves ONE padded frame shape: every admitted frame must
+    pad (``InputPadder(mode='sintel', bucket=pad_bucket)``) to the same
+    (H, W) the slot table was allocated at, so the executable set is
+    exactly ``len(batch_sizes)`` programs and a stream lifecycle event
+    (admission, eviction, anomaly reset, slot reuse) can never compile
+    anything. ``capacity`` bounds the device slot table — the HBM
+    contract: per-stream recurrent state is ``h/8 * w/8 * (2 +
+    hidden_dim if carry_net)`` floats, allocated once, never grown.
+    """
+
+    # Concurrent-stream bound = slot-table size. Stream admission beyond
+    # it sheds with a retry_after hint (soonest idle-expiry), it never
+    # queues: a stream that cannot get a slot cannot make progress.
+    capacity: int = 8
+    # Native frame size the engine serves (frames whose PADDED shape
+    # matches are also admitted — pad bucketing collapses near-identical
+    # camera resolutions onto one slot-table shape).
+    frame_hw: tuple[int, int] = (96, 128)
+    pad_bucket: int = 0  # same semantics as ServeConfig.pad_bucket
+    iters: int = 12  # fixed GRU iterations (one executable per batch size)
+    # Allowed batch programs, ascending (zero-row padding up to the
+    # nearest size, exactly like serving). A batch never holds two
+    # frames of the SAME stream — state must flow through the slot table
+    # between them — so sizes beyond `capacity` are never filled.
+    batch_sizes: tuple[int, ...] = (1, 2, 4)
+    # Frame admission queue bound (frames, across all streams).
+    queue_capacity: int = 64
+    # Warm-start staleness: a frame whose index gap to the previously
+    # ADMITTED frame of its stream exceeds this warm-starts from COLD
+    # (never from stale state). 1 = only strictly consecutive frames
+    # may warm-start.
+    max_frame_gap: int = 1
+    # Idle/abandoned-stream eviction: a stream with no admitted frame
+    # for this long (and nothing in flight) loses its slot.
+    idle_timeout_s: float = 30.0
+    # Also carry the GRU hidden state (net) across frames, not just the
+    # forward-splatted flow. OFF by default: the reference's warm-start
+    # carries flow only (core/utils/utils.py:28-56); net carry is an
+    # extension and changes numerics vs the reference eval.
+    carry_net: bool = False
+    # In-graph anomaly bound: a frame whose low-res flow is non-finite
+    # or exceeds this magnitude resets ITS stream's slot to cold-start
+    # (batch-mates untouched).
+    anomaly_max_flow: float = 1e4
+    # Shed hint before any service-time estimate exists.
+    default_retry_after_s: float = 0.25
+    # ShapeCachedForward LRU bound; >= len(batch_sizes) (+1 when the
+    # engine shares its cache with a warmstart splat program).
+    cache_size: int = 8
+    inflight: int | None = None  # DispatchThrottle bound (None = default)
+    drain_depth: int = 2  # AsyncDrain queue depth
+    # Query-chunk size of the in-graph warm-start splat
+    # (ops/warmstart.forward_interpolate_jax): bounds the transient
+    # distance matrix at chunk * (h/8 * w/8) * 4 bytes per stream row.
+    splat_chunk: int = 1024
+
+    def __post_init__(self) -> None:
+        bs = tuple(int(b) for b in self.batch_sizes)
+        if not bs or any(b <= 0 for b in bs) or list(bs) != sorted(set(bs)):
+            raise ValueError(
+                f"batch_sizes must be ascending unique positives: {bs!r}"
+            )
+        if self.capacity < 1:
+            raise ValueError(f"capacity must be >= 1: {self.capacity}")
+        if self.iters < 1:
+            raise ValueError(f"iters must be >= 1: {self.iters}")
+        if self.max_frame_gap < 1:
+            raise ValueError(
+                f"max_frame_gap must be >= 1: {self.max_frame_gap}"
+            )
+
+    @property
+    def max_batch(self) -> int:
+        return self.batch_sizes[-1]
+
+
 def _to_jsonable(obj: Any) -> Any:
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         return {k: _to_jsonable(v) for k, v in dataclasses.asdict(obj).items()}

@@ -46,7 +46,8 @@ from raft_ncup_tpu.inference.pipeline import (
 )
 from raft_ncup_tpu.io import write_flo, write_flow_kitti
 from raft_ncup_tpu.models.raft import RAFT
-from raft_ncup_tpu.ops import InputPadder, forward_interpolate
+from raft_ncup_tpu.ops import InputPadder
+from raft_ncup_tpu.ops.warmstart import forward_interpolate_batch
 from raft_ncup_tpu.parallel.multihost import (
     allreduce_sum_across_hosts,
     is_main_process,
@@ -244,6 +245,77 @@ def _run_metric_pass(
     return np.asarray(jax.device_get(acc), np.float64)
 
 
+# The device-side warm-start splat: jit caches one tiny executable per
+# low-res shape; the result stays on device and feeds the next frame's
+# flow_init (submissions) or metric program (warm-start validation).
+_device_splat = jax.jit(lambda f: forward_interpolate_batch(f))
+
+
+def _run_warmstart_metric_pass(
+    fwd: ShapeCachedForward,
+    dataset,
+    *,
+    kind: str,
+    iters: int,
+    pad_mode: str = "sintel",
+    num_workers: int = 4,
+    sequence_of=None,
+) -> np.ndarray:
+    """Warm-start validation pass: frames stream IN ORDER (batch size 1
+    — warm start is a serial per-sequence dependence), each frame's
+    metric folds on device inside the jitted forward, and the next
+    frame's ``flow_init`` is the device forward-splat of this frame's
+    low-res flow. The chain ``flow_lr → splat → flow_init`` never
+    touches the host; the window ends with ONE sanctioned
+    ``jax.device_get`` of the accumulator sums.
+
+    ``sequence_of(sample)`` names the sample's sequence (default: first
+    element of ``extra_info``); a sequence change resets the warm chain
+    to cold (zeros ``flow_init`` — bitwise identical to a cold start,
+    and the SAME executable, so sequence boundaries cannot recompile).
+
+    Single-host only: warm start needs sequence-adjacent frames, which
+    is exactly what ``_HostShard``'s round-robin would destroy.
+    """
+    import jax.numpy as jnp
+
+    if sequence_of is None:
+        def sequence_of(s):
+            info = s.get("extra_info")
+            return info[0] if info else None
+
+    acc = metrics_mod.init_acc(kind)
+    throttle = DispatchThrottle()
+    flow_prev = None
+    seq_prev = object()  # never equal to a real sequence name
+    with SamplePrefetcher(dataset, num_workers=num_workers) as samples:
+        for s in samples:
+            sequence = sequence_of(s)
+            if sequence != seq_prev:
+                flow_prev = None
+            img1 = np.asarray(s["image1"], np.float32)[None]
+            img2 = np.asarray(s["image2"], np.float32)[None]
+            gt = np.asarray(s["flow"], np.float32)[None]
+            padder = InputPadder(img1.shape, mode=pad_mode)
+            pad = padder.pad_spec
+            img1, img2 = _pad_host(pad, img1, img2)
+            if flow_prev is None:
+                # Cold frames reuse the warm executable with a zero
+                # init (coords + 0 is bitwise the cold start), so the
+                # whole pass is ONE program per shape.
+                h8, w8 = img1.shape[1] // 8, img1.shape[2] // 8
+                flow_prev = jnp.zeros((1, h8, w8, 2), jnp.float32)
+            batch = {"image1": img1, "image2": img2, "flow": gt}
+            acc, flow_lr = fwd.metrics(
+                batch, iters=iters, acc=acc, kind=kind, pad=pad,
+                flow_init=flow_prev,
+            )
+            flow_prev = _device_splat(flow_lr)
+            throttle.push(acc)
+            seq_prev = sequence
+    return np.asarray(jax.device_get(acc), np.float64)
+
+
 def validate_chairs(
     model: RAFT, variables: dict, data_cfg: Optional[DataConfig] = None,
     iters: int = 24, batch_size: int = 4, mesh=None,
@@ -275,46 +347,83 @@ def validate_chairs(
 def validate_sintel(
     model: RAFT, variables: dict, data_cfg: Optional[DataConfig] = None,
     iters: int = 32, batch_size: int = 2, mesh=None,
+    warm_start: bool = False,
 ) -> dict:
     """Sintel train-split clean+final EPE / 1px / 3px / 5px
-    (reference: evaluate.py:111-143)."""
+    (reference: evaluate.py:111-143).
+
+    ``warm_start=True`` evaluates the video scenario the reference's
+    ``--warm_start`` submission uses: frames stream sequentially (batch
+    size 1), each frame's ``flow_init`` is the device forward-splat of
+    the previous frame's low-res flow, and sequence changes reset to
+    cold. Single-host only (the warm chain needs sequence-adjacent
+    frames; host-sharding would break it) and incompatible with a
+    spatial mesh."""
     cfg = data_cfg or DataConfig()
+    if warm_start and (mesh is not None or is_multihost()):
+        raise ValueError(
+            "warm-start validation is a serial per-sequence chain: "
+            "single host, no mesh (see _run_warmstart_metric_pass)"
+        )
     fwd = ShapeCachedForward(
         model, variables, mesh=mesh, cache_size=cfg.eval_cache_size
     )
     results = {}
+    prefix = "warm_" if warm_start else ""
     for dstype in ("clean", "final"):
         dataset = ds_mod.MpiSintel(
             None, split="training", root=cfg.root_sintel, dstype=dstype
         )
-        dataset, n, do_reduce = _shard_for_validation(dataset, mesh)
-        if n == 0:
-            _print_main(
-                f"validate_sintel: no {dstype} data under "
-                f"{cfg.root_sintel}, skipping"
+        if warm_start:
+            if len(dataset) == 0:
+                _print_main(
+                    f"validate_sintel: no {dstype} data under "
+                    f"{cfg.root_sintel}, skipping"
+                )
+                continue
+            acc = _run_warmstart_metric_pass(
+                fwd, dataset, kind="px", iters=iters,
+                num_workers=cfg.num_workers,
             )
-            continue
-        acc = _run_metric_pass(
-            fwd, dataset, kind="px", iters=iters, batch_size=batch_size,
-            mesh=mesh, pad_mode="sintel",
-            num_workers=cfg.num_workers, depth=cfg.device_prefetch,
-        )
-        if do_reduce:
-            acc = allreduce_sum_across_hosts(acc)
+        else:
+            dataset, n, do_reduce = _shard_for_validation(dataset, mesh)
+            if n == 0:
+                _print_main(
+                    f"validate_sintel: no {dstype} data under "
+                    f"{cfg.root_sintel}, skipping"
+                )
+                continue
+            acc = _run_metric_pass(
+                fwd, dataset, kind="px", iters=iters,
+                batch_size=batch_size, mesh=mesh, pad_mode="sintel",
+                num_workers=cfg.num_workers, depth=cfg.device_prefetch,
+            )
+            if do_reduce:
+                acc = allreduce_sum_across_hosts(acc)
         m = metrics_mod.finalize("px", acc)
         _print_main(
-            f"Validation ({dstype}) EPE: {m['epe']:f}, 1px: {m['1px']:f}, "
-            f"3px: {m['3px']:f}, 5px: {m['5px']:f}"
+            f"Validation ({prefix}{dstype}) EPE: {m['epe']:f}, "
+            f"1px: {m['1px']:f}, 3px: {m['3px']:f}, 5px: {m['5px']:f}"
         )
-        results[dstype] = m["epe"]
+        results[f"{prefix}{dstype}"] = m["epe"]
         results.update(
             {
-                f"{dstype}_1px": m["1px"],
-                f"{dstype}_3px": m["3px"],
-                f"{dstype}_5px": m["5px"],
+                f"{prefix}{dstype}_1px": m["1px"],
+                f"{prefix}{dstype}_3px": m["3px"],
+                f"{prefix}{dstype}_5px": m["5px"],
             }
         )
     return results
+
+
+def validate_sintel_warm(
+    model: RAFT, variables: dict, data_cfg: Optional[DataConfig] = None,
+    **kwargs,
+) -> dict:
+    """Sintel warm-start (video) validation — see :func:`validate_sintel`."""
+    return validate_sintel(
+        model, variables, data_cfg, warm_start=True, **kwargs
+    )
 
 
 def validate_kitti(
@@ -368,9 +477,12 @@ def create_sintel_submission(
     Full-field pulls are unavoidable here — the deliverable IS the flow
     field — but they ride the :class:`AsyncDrain` worker: dispatch of
     frame N+1 overlaps the device→host pull and file write of frame N.
-    Warm start keeps ONE serial pull per frame (the next frame's
-    ``flow_init`` depends on this frame's low-res flow — an inherent
-    data dependence, JGL008-allowlisted).
+    The warm-start splat runs ON DEVICE
+    (``ops/warmstart.forward_interpolate_jax``): the next frame's
+    ``flow_init`` is the jitted forward-splat of this frame's device
+    ``flow_lr``, so the serial per-frame device→host pull the host
+    cKDTree splat used to force (the last JGL008 allowlist entry) is
+    gone — the warm-start chain never leaves the device.
 
     On a pod EVERY process runs the forwards (with a global mesh the
     SPMD program requires all participants — an early return on non-main
@@ -406,13 +518,12 @@ def create_sintel_submission(
                     img1, img2, iters, flow_init=flow_prev
                 )
                 if warm_start:
-                    # Inherent serial dependence: the NEXT frame's input
-                    # needs this frame's low-res flow on host now. One
-                    # small sanctioned pull; the full field still drains
-                    # asynchronously below.
-                    flow_prev = forward_interpolate(
-                        jax.device_get(flow_lr)[0]
-                    )[None]
+                    # The next frame's flow_init is this frame's
+                    # forward-splatted low-res flow — computed on
+                    # device, handed straight back to the next
+                    # forward_device call as a device array. No host
+                    # round-trip in the warm-start chain.
+                    flow_prev = _device_splat(flow_lr)
                 if write:
                     drain.submit(
                         flow_up,
@@ -600,6 +711,7 @@ def validate_synthetic_rigid(
 VALIDATORS = {
     "chairs": validate_chairs,
     "sintel": validate_sintel,
+    "sintel_warm": validate_sintel_warm,
     "kitti": validate_kitti,
     "synthetic": validate_synthetic,
     "synthetic_rigid": validate_synthetic_rigid,

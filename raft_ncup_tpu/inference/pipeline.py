@@ -397,6 +397,15 @@ class ShapeCachedForward:
 
     # ------------------------------------------------------------- forwards
 
+    def custom(self, key: tuple, build):
+        """Compile-once entry for subsystem-specific jitted programs that
+        want this cache's LRU bound and compiles/hits/evictions
+        accounting (the streaming engine's slot-table step programs,
+        keyed by batch size). ``build()`` must return the compiled-on-
+        first-call callable; ``key`` is namespaced away from the forward
+        and metric keys."""
+        return self._get(("custom",) + tuple(key), build)
+
     def forward_device(self, image1, image2, iters: int, flow_init=None):
         """Test-mode forward; returns DEVICE arrays (flow_lr, flow_up).
 
@@ -440,13 +449,24 @@ class ShapeCachedForward:
             self.forward_device(image1, image2, iters, flow_init)
         )
 
-    def metrics(self, batch: dict, *, iters: int, acc, kind: str, pad=None):
+    def metrics(
+        self, batch: dict, *, iters: int, acc, kind: str, pad=None,
+        flow_init=None,
+    ):
         """Forward + on-device metric fold in ONE jitted program.
 
         ``batch`` holds ``image1``/``image2`` (padded) plus ``flow`` and
         optionally ``valid``/``band`` at native shape; ``pad`` is the
         static ``InputPadder.pad_spec``. Returns the updated accumulator
         (device-resident). No flow field ever reaches the host.
+
+        ``flow_init`` (warm-start validation): a device-resident
+        (B, H/8, W/8, 2) initial low-res flow; when given the program
+        additionally returns the final low-res flow so the caller can
+        carry it to the next frame — the return becomes
+        ``(acc, flow_lr)`` instead of ``acc``, and the warm-start chain
+        stays entirely on device (evaluation._run_warmstart_metric_pass
+        splats it with ops/warmstart.forward_interpolate_jax).
 
         The accumulator is deliberately NOT donated: donating an operand
         that is still pending (each batch's ``acc`` is the previous
@@ -457,6 +477,7 @@ class ShapeCachedForward:
         extras = {
             k: batch[k] for k in ("flow", "valid", "band") if k in batch
         }
+        warm = flow_init is not None
         key = (
             "metrics",
             tuple(batch["image1"].shape),
@@ -465,10 +486,33 @@ class ShapeCachedForward:
             iters,
             kind,
             pad,
+            warm,
         )
 
         def build():
             mesh = self.mesh
+
+            if warm:
+
+                def fn(v, i1, i2, extra, acc_in, finit):
+                    def head(flow_up):
+                        return metrics_mod.accumulate(
+                            kind,
+                            acc_in,
+                            flow_up,
+                            extra["flow"],
+                            valid=extra.get("valid"),
+                            band=extra.get("band"),
+                            pad=pad,
+                        )
+
+                    flow_lr, acc_out = self.model.apply(
+                        v, i1, i2, iters=iters, flow_init=finit,
+                        test_mode=True, mesh=mesh, metric_head=head,
+                    )
+                    return acc_out, flow_lr
+
+                return self._jit(fn, 2, 3, n_out=2)
 
             def fn(v, i1, i2, extra, acc_in):
                 def head(flow_up):
@@ -490,6 +534,7 @@ class ShapeCachedForward:
 
             return self._jit(fn, 2, 2, n_out=1)
 
-        return self._get(key, build)(
-            self.variables, batch["image1"], batch["image2"], extras, acc
-        )
+        args = (self.variables, batch["image1"], batch["image2"], extras, acc)
+        if warm:
+            args += (flow_init,)
+        return self._get(key, build)(*args)

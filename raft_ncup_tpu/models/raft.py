@@ -160,6 +160,9 @@ class RAFT:
         mesh=None,
         spatial_axis: str = "spatial",
         metric_head: Optional[Any] = None,
+        net_init: Optional[jax.Array] = None,
+        net_warm: Optional[jax.Array] = None,
+        return_net: bool = False,
     ):
         """Estimate optical flow between a pair of NHWC image batches.
 
@@ -175,6 +178,17 @@ class RAFT:
         (inference/metrics.py) through this hook so the compiled eval
         program emits a handful of scalars per batch — the full flow
         field never leaves the device on the validation path.
+
+        ``net_init``/``net_warm``/``return_net`` (streaming warm start,
+        raft_ncup_tpu/streaming/): ``net_init`` is a (B, H/8, W/8,
+        hidden_dim) GRU hidden state carried from a previous frame;
+        rows where the (B,)-bool ``net_warm`` is True START the
+        refinement from it instead of the context encoder's
+        ``tanh`` initialization (a ``jnp.where`` select, so cold rows
+        are BITWISE the default cold start — the streaming engine's
+        per-stream isolation contract). ``return_net=True`` (test mode
+        only) appends the final hidden state to the result:
+        ``(flow_lr, flow_up, net)``.
 
         ``mesh``/``spatial_axis``: when running under a (data x spatial)
         SPMD mesh, the on-the-fly correlation lookup is wrapped in
@@ -311,6 +325,20 @@ class RAFT:
         cnet_out = run("cnet", self.cnet, img1, train=train, bn_train=bn_train)
         net = jnp.tanh(cnet_out[..., :hdim])
         inp = jax.nn.relu(cnet_out[..., hdim:])
+        if net_init is not None:
+            # Carried GRU state replaces the cold init per batch row; the
+            # select (not arithmetic blend) keeps cold rows bitwise equal
+            # to a run without any carry. `inp` is deliberately NOT
+            # carried: it is the context encoding of the CURRENT frame —
+            # an input feature, not recurrent state — and reusing a stale
+            # frame's encoding would feed the update GRU wrong data.
+            carried = net_init.astype(net.dtype)
+            if net_warm is None:
+                net = carried
+            else:
+                net = jnp.where(
+                    net_warm[:, None, None, None], carried, net
+                )
 
         B, H, W, _ = image1.shape
         coords0 = coords_grid(B, H // 8, W // 8)
@@ -395,10 +423,15 @@ class RAFT:
             )
             if metric_head is not None:
                 flow_up = metric_head(flow_up)
-            result = (coords1 - coords0, flow_up)
+            if return_net:
+                result = (coords1 - coords0, flow_up, net)
+            else:
+                result = (coords1 - coords0, flow_up)
         else:
             if metric_head is not None:
                 raise ValueError("metric_head requires test_mode=True")
+            if return_net:
+                raise ValueError("return_net requires test_mode=True")
             result = flow_seq
 
         if mutable:

@@ -20,4 +20,8 @@ from raft_ncup_tpu.ops.nconv import (  # noqa: F401
     zero_stuff_upsample,
 )
 from raft_ncup_tpu.ops.padding import InputPadder  # noqa: F401
-from raft_ncup_tpu.ops.warmstart import forward_interpolate  # noqa: F401
+from raft_ncup_tpu.ops.warmstart import (  # noqa: F401
+    forward_interpolate,
+    forward_interpolate_batch,
+    forward_interpolate_jax,
+)

@@ -5,14 +5,37 @@ the full grid by nearest-neighbor interpolation — the reference's
 scipy-``griddata`` warm start used by video-sequence evaluation
 (reference: core/utils/utils.py:28-56, used at evaluate.py:38-42).
 
-Host-side numpy: this runs once per frame between device steps, on the
-(H/8, W/8, 2) low-res flow, so a cKDTree nearest query is cheap and avoids
-pulling scipy's slower ``griddata`` wrapper into the loop.
+Two implementations of the same math:
+
+- :func:`forward_interpolate` — host numpy + cKDTree. The original
+  port: exact Euclidean nearest-neighbor query over the splatted float
+  points. Kept as the parity reference and for host-side tooling.
+- :func:`forward_interpolate_jax` — pure JAX, traceable, device-
+  resident. Same strict-inequality validity mask, same
+  nearest-neighbor fill computed by a chunked brute-force distance
+  argmin (the low-res grid is small — (H/8)*(W/8) points — so the
+  all-pairs distance matrix is a few dozen MB at 1080p and chunking
+  bounds the transient). This is what lets per-stream recurrent state
+  stay in HBM between frames: the streaming engine
+  (``raft_ncup_tpu/streaming/``) and the Sintel warm-start submission
+  path trace it into the same program as the gather/scatter around it,
+  deleting the per-frame device→host pull the host version forced
+  (the last JGL008-allowlisted pull in the inference path, now gone).
+
+Parity: tests/test_warmstart.py pins the JAX splat against the host
+cKDTree version on dense, sparse-survivor, and all-points-out-of-bounds
+fixtures. Exact ties in the nearest query are measure-zero for
+continuous flow fields; both sides break them by index order on the
+fixtures used.
 """
 
 from __future__ import annotations
 
 import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
 
 
 def forward_interpolate(flow: np.ndarray) -> np.ndarray:
@@ -21,6 +44,8 @@ def forward_interpolate(flow: np.ndarray) -> np.ndarray:
     Points whose destination leaves the open interval (0, W)x(0, H) are
     dropped (matching the reference's strict inequalities,
     core/utils/utils.py:43); if nothing survives, returns zeros.
+    Host numpy + scipy cKDTree; see :func:`forward_interpolate_jax` for
+    the traceable device equivalent.
     """
     from scipy.spatial import cKDTree  # deferred: scipy only needed here
 
@@ -44,3 +69,82 @@ def forward_interpolate(flow: np.ndarray) -> np.ndarray:
     query = np.stack([x0.ravel(), y0.ravel()], axis=1)
     _, idx = cKDTree(pts).query(query, k=1)
     return vals[idx].reshape(ht, wd, 2).astype(np.float32)
+
+
+def forward_interpolate_jax(
+    flow: jax.Array, chunk: int = 1024
+) -> jax.Array:
+    """Traceable (H, W, 2) forward splat + nearest fill, all on device.
+
+    Mirrors :func:`forward_interpolate` exactly: splat destinations are
+    the float points ``(x0 + dx, y0 + dy)``, validity is the same strict
+    open interval, and every grid cell takes the value of its nearest
+    surviving point (Euclidean, index-order tie-break — the same winner
+    ``jnp.argmin``'s first-minimum rule picks). If no point survives the
+    bounds check, the result is all zeros.
+
+    The nearest query is a brute-force masked distance argmin instead of
+    a KD-tree: at warm-start resolution (1/8 of the frame) the grid has
+    a few thousand points, so the (chunk, H*W) distance block is small
+    and MXU-shaped. ``chunk`` bounds the transient: queries are
+    processed ``chunk`` rows at a time via ``lax.map`` (peak extra
+    memory ``chunk * H*W * 4`` bytes).
+
+    Data-dependent work (validity count) is handled with masking, not
+    shape changes, so one compilation serves every frame.
+    """
+    if flow.ndim != 3 or flow.shape[2] != 2:
+        raise ValueError(f"flow must be (H, W, 2), got {flow.shape}")
+    ht, wd = flow.shape[:2]
+    n = ht * wd
+    flow = flow.astype(jnp.float32)
+    dx = flow[..., 0].ravel()
+    dy = flow[..., 1].ravel()
+    x0, y0 = jnp.meshgrid(
+        jnp.arange(wd, dtype=jnp.float32),
+        jnp.arange(ht, dtype=jnp.float32),
+    )
+    qx, qy = x0.ravel(), y0.ravel()
+
+    x1 = qx + dx
+    y1 = qy + dy
+    valid = (x1 > 0) & (x1 < wd) & (y1 > 0) & (y1 < ht)
+    any_valid = valid.any()
+    # Invalid points park at +inf so every real query beats them; if NO
+    # point is valid argmin degenerates to index 0 and the final select
+    # zeroes the whole field.
+    inf = jnp.float32(jnp.inf)
+    px = jnp.where(valid, x1, inf)
+    py = jnp.where(valid, y1, inf)
+    vals = jnp.stack([dx, dy], axis=1)  # (N, 2)
+
+    # chunk and n are static python ints (n comes from the shape), so
+    # this is trace-time arithmetic, not a tracer round-trip.
+    c = min(max(1, chunk), n)
+    n_pad = (-n) % c
+    qxp = jnp.pad(qx, (0, n_pad))
+    qyp = jnp.pad(qy, (0, n_pad))
+    q = jnp.stack([qxp, qyp], axis=1).reshape(-1, c, 2)
+
+    def nearest(q_block: jax.Array) -> jax.Array:
+        d2 = (q_block[:, 0, None] - px[None, :]) ** 2 + (
+            q_block[:, 1, None] - py[None, :]
+        ) ** 2  # (c, N)
+        return jnp.argmin(d2, axis=1)
+
+    idx = lax.map(nearest, q).reshape(-1)[:n]
+    out = vals[idx].reshape(ht, wd, 2)
+    return jnp.where(any_valid, out, jnp.zeros_like(out))
+
+
+def forward_interpolate_batch(
+    flow: jax.Array, chunk: int = 1024
+) -> jax.Array:
+    """Batched traceable splat: (B, H, W, 2) -> (B, H, W, 2).
+
+    vmap of :func:`forward_interpolate_jax` — each stream's warm start
+    is independent, so a corrupt or cold batch row can never leak into
+    its batch-mates (the streaming engine's isolation contract rides on
+    this row-independence).
+    """
+    return jax.vmap(lambda f: forward_interpolate_jax(f, chunk))(flow)

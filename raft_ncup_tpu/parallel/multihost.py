@@ -35,6 +35,23 @@ def initialize_distributed(
     if num_processes == 1:
         return
     explicit = coordinator_address is not None or process_id is not None
+    if explicit and (num_processes or 0) > 1:
+        # Cross-process computations on the CPU backend need an actual
+        # collectives transport; without one XLA refuses to compile any
+        # multiprocess program ("Multiprocess computations aren't
+        # implemented on the CPU backend"). Gloo ships with jaxlib and
+        # only affects the CPU backend, so enable it when we are about
+        # to join a multi-process runtime — this is what lets the
+        # distributed tests (tests/test_multihost.py) run real
+        # multi-host SPMD on virtual CPU devices. Guarded to the
+        # explicit-args path: touching this config on the no-op
+        # single-process path would re-initialize an already-live
+        # backend (and on the axon tunnel, re-resolve a platform that
+        # must only be initialized once).
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception as e:  # option absent / backend already up
+            print(f"cpu collectives not configured: {e}")
     try:
         jax.distributed.initialize(
             coordinator_address, num_processes, process_id

@@ -35,6 +35,23 @@ coordinate is a request index in the deterministic traffic stream):
   server drains everything admitted, then exits clean
   (:data:`EXIT_PREEMPTED`).
 
+Streaming events (consumed by ``streaming/traffic.py`` + the
+``serve.py --stream`` driver; the coordinate is a frame index in the
+deterministic multi-stream schedule):
+
+- ``corruptframe@N`` — frame ``N``'s first image is all-NaN → the
+  engine's IN-GRAPH anomaly check must reset only the owning stream's
+  slot to cold-start while co-batched streams' flow stays bitwise
+  identical to an uninjected run (the streaming analogue of serving's
+  poison quarantine).
+- ``abandon@N`` — the stream that owns frame ``N`` stops submitting
+  after it (no close) → idle eviction must free its slot after
+  ``idle_timeout_s`` and the slot must be reusable without a recompile.
+- ``burst@N`` — reused for streaming: at frame ``N``'s due time a burst
+  of ``burst_size`` EXTRA single-frame streams arrives → stream
+  admission must shed the overflow (slots are a hard capacity), not
+  queue it.
+
 NaN injection wraps the *host batch stream* (order-preserving, so batch
 ``i`` of the stream is exactly the batch step ``start_step + i``
 consumes, prefetch depth notwithstanding); the SIGTERM trigger lives in
@@ -52,7 +69,8 @@ import numpy as np
 
 ENV_VAR = "RAFT_NCUP_CHAOS"
 
-_KINDS = ("nan", "ioerror", "sigterm", "burst", "poison")
+_KINDS = ("nan", "ioerror", "sigterm", "burst", "poison", "corruptframe",
+          "abandon")
 
 
 @dataclass(frozen=True)
@@ -64,13 +82,12 @@ class ChaosSpec:
     sigterm_after: Optional[int] = None
     burst_requests: frozenset = frozenset()
     poison_requests: frozenset = frozenset()
+    corrupt_frames: frozenset = frozenset()
+    abandon_frames: frozenset = frozenset()
 
     @classmethod
     def parse(cls, spec: Optional[str]) -> "ChaosSpec":
-        nan: set = set()
-        ioe: set = set()
-        burst: set = set()
-        poison: set = set()
+        sets: dict = {k: set() for k in _KINDS if k != "sigterm"}
         sig: Optional[int] = None
         for token in (spec or "").split(","):
             token = token.strip()
@@ -83,23 +100,25 @@ class ChaosSpec:
                     f"{'/'.join(_KINDS)}@N, comma-joined)"
                 )
             n = int(value)
-            if kind == "nan":
-                nan.add(n)
-            elif kind == "ioerror":
-                ioe.add(n)
-            elif kind == "burst":
-                burst.add(n)
-            elif kind == "poison":
-                poison.add(n)
-            else:
+            if kind == "sigterm":
                 sig = n
-        return cls(frozenset(nan), frozenset(ioe), sig,
-                   frozenset(burst), frozenset(poison))
+            else:
+                sets[kind].add(n)
+        return cls(
+            frozenset(sets["nan"]),
+            frozenset(sets["ioerror"]),
+            sig,
+            frozenset(sets["burst"]),
+            frozenset(sets["poison"]),
+            frozenset(sets["corruptframe"]),
+            frozenset(sets["abandon"]),
+        )
 
     @property
     def active(self) -> bool:
         return bool(self.nan_steps or self.ioerror_reads
                     or self.burst_requests or self.poison_requests
+                    or self.corrupt_frames or self.abandon_frames
                     or self.sigterm_after is not None)
 
     def render(self) -> str:
@@ -107,6 +126,8 @@ class ChaosSpec:
         parts += [f"ioerror@{n}" for n in sorted(self.ioerror_reads)]
         parts += [f"burst@{n}" for n in sorted(self.burst_requests)]
         parts += [f"poison@{n}" for n in sorted(self.poison_requests)]
+        parts += [f"corruptframe@{n}" for n in sorted(self.corrupt_frames)]
+        parts += [f"abandon@{n}" for n in sorted(self.abandon_frames)]
         if self.sigterm_after is not None:
             parts.append(f"sigterm@{self.sigterm_after}")
         return ",".join(parts) or "<none>"

@@ -80,6 +80,7 @@ class AdmissionQueue:
         max_n: int,
         timeout: Optional[float] = None,
         key_fn: Optional[Callable[[FlowRequest], object]] = None,
+        distinct_fn: Optional[Callable[[FlowRequest], object]] = None,
     ) -> List[FlowRequest]:
         """Pop the head plus up to ``max_n - 1`` FIFO-adjacent requests
         sharing its ``key_fn`` value (default: ``shape_key``).
@@ -87,6 +88,15 @@ class AdmissionQueue:
         Blocks up to ``timeout`` for the first request; returns ``[]``
         on timeout or when closed-and-empty (the dispatcher's exit
         signal). Requests with a different key stay queued in order.
+
+        ``distinct_fn`` (the streaming engine's batching rule): at most
+        ONE popped request per distinct value — a second frame of the
+        same stream must read the slot state its predecessor writes, so
+        it cannot share a batch with it. A duplicate is *skipped in
+        place* (it keeps its queue position and its per-stream FIFO
+        order) and the scan continues to later same-key requests; the
+        scan still stops at the first different-key request, so batches
+        never reorder across shapes.
         """
         key_fn = key_fn or (lambda r: r.shape_key)
         with self._cond:
@@ -98,6 +108,25 @@ class AdmissionQueue:
             head = self._q.popleft()
             batch = [head]
             want = key_fn(head)
-            while self._q and len(batch) < max_n and key_fn(self._q[0]) == want:
-                batch.append(self._q.popleft())
+            if distinct_fn is None:
+                while (
+                    self._q
+                    and len(batch) < max_n
+                    and key_fn(self._q[0]) == want
+                ):
+                    batch.append(self._q.popleft())
+                return batch
+            seen = {distinct_fn(head)}
+            i = 0
+            while i < len(self._q) and len(batch) < max_n:
+                req = self._q[i]
+                if key_fn(req) != want:
+                    break  # never reorder across shape keys
+                d = distinct_fn(req)
+                if d in seen:
+                    i += 1  # same stream: keeps its position and order
+                    continue
+                del self._q[i]
+                batch.append(req)
+                seen.add(d)
             return batch

@@ -1,0 +1,40 @@
+"""Streaming video engine: many concurrent stateful streams multiplexed
+into one batched, jitted, device-resident warm-start step.
+
+The scenario this subsystem opens (ROADMAP item 2): continuous video.
+Per-stream recurrent state (previous low-res flow and optionally the
+GRU hidden state) lives in a fixed-capacity HBM slot table
+(``slots.py``); frames from many streams batch together through one
+compiled step per batch size (``engine.py``), with the warm-start
+forward splat re-expressed in pure JAX
+(``ops/warmstart.forward_interpolate_jax``) so state never leaves the
+device between frames. The robustness layer — bounded stream admission
+with shedding, idle/abandoned-stream eviction, in-graph per-stream
+anomaly reset, frame-gap staleness, graceful drain — is chaos-tested
+end to end (tests/test_streaming.py; docs/STREAMING.md).
+"""
+
+from raft_ncup_tpu.config import StreamConfig
+from raft_ncup_tpu.streaming.engine import (
+    FrameRequest,
+    StreamEngine,
+    StreamStats,
+)
+from raft_ncup_tpu.streaming.slots import (
+    SlotRegistry,
+    StreamState,
+    init_slot_table,
+)
+from raft_ncup_tpu.streaming.traffic import StreamTraffic, replay_streams
+
+__all__ = [
+    "FrameRequest",
+    "SlotRegistry",
+    "StreamConfig",
+    "StreamEngine",
+    "StreamState",
+    "StreamStats",
+    "StreamTraffic",
+    "init_slot_table",
+    "replay_streams",
+]

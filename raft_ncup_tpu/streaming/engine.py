@@ -1,0 +1,712 @@
+"""The multi-stream video engine: device-resident warm start over a
+fixed-capacity slot table, with per-stream fault isolation.
+
+Data path (one dispatcher thread; clients submit from their own
+threads):
+
+1. **stream admission** (client thread, inside ``submit``): an unknown
+   ``stream_id`` claims the lowest free slot; a full table first evicts
+   idle-expired streams, then sheds with an honest ``retry_after_s``
+   (time until the soonest slot becomes reclaimable). Slots are a HARD
+   capacity — a stream without a slot cannot make progress, so stream
+   overload sheds instead of queueing (``serving/admission.py``'s
+   discipline lifted from requests to streams).
+2. **frame admission**: metadata validation (shape/dtype, padded shape
+   must equal the engine's slot-table shape, per-stream frame indices
+   strictly increasing), staleness decision (index gap >
+   ``max_frame_gap`` ⇒ this frame is forced COLD — a stale warm start
+   is worse than none), then a non-blocking ``AdmissionQueue.offer``.
+3. **assemble** (dispatcher): ``pop_batch(..., distinct_fn=stream)``
+   pops a FIFO run of frames from DISTINCT streams — two frames of one
+   stream must be chained through the slot table, never batched
+   together — and zero-pads rows up to the nearest allowed batch size;
+   pad rows target the scratch slot.
+4. **step** (one jitted program per batch size, compiled once): gather
+   prev state by slot index → in-graph forward splat
+   (``ops/warmstart.forward_interpolate_jax``) masked by the device
+   warm flags → batched RAFT forward (optionally seeding the GRU with
+   the carried ``net``) → per-row anomaly check (non-finite or
+   diverged low-res flow) → scatter the new state back, with anomalous
+   rows reset to cold. State never leaves the device between frames.
+5. **deliver** (drain worker): the batch's ``(flow_up, bad_flags)``
+   ride ONE sanctioned ``jax.device_get`` in the ``AsyncDrain`` worker;
+   anomalous rows answer ``rejected`` (their stream just went cold),
+   healthy rows answer ``ok`` with the unpadded native flow.
+
+Isolation contract (pinned bitwise in tests/test_streaming.py): a
+corrupt frame affects exactly one batch row and one slot — batch-mates'
+outputs are bitwise identical to an uninjected run (test-mode rows are
+batch-independent and every mask is a ``jnp.where`` select, never an
+arithmetic blend), and the reset stream's next frame is bitwise a cold
+start. Eviction and slot reuse touch no device memory (the new owner's
+first frame is forced cold), so the steady-state executable set is
+exactly ``len(batch_sizes)`` programs: zero recompiles, zero implicit
+host transfers (``bench.py``'s ``stream_*`` row records both).
+
+Drain contract: ``drain()`` stops stream and frame admission, flushes
+every admitted frame through compute, tears down, and returns the final
+stats — nothing admitted is silently lost (``serve.py --stream`` wires
+it to SIGTERM via ``resilience/preemption.PreemptionHandler`` ⇒ exit
+75).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from raft_ncup_tpu.config import StreamConfig
+from raft_ncup_tpu.inference.pipeline import (
+    AsyncDrain,
+    DispatchThrottle,
+    ShapeCachedForward,
+)
+from raft_ncup_tpu.ops.padding import InputPadder
+from raft_ncup_tpu.serving.admission import AdmissionQueue
+from raft_ncup_tpu.serving.request import (
+    STATUS_ERROR,
+    STATUS_OK,
+    STATUS_REJECTED,
+    STATUS_SHED,
+    FlowResponse,
+    ServeHandle,
+)
+from raft_ncup_tpu.streaming.slots import SlotRegistry, init_slot_table
+
+_POLL_S = 0.05  # dispatcher wake cadence while the queue is idle
+
+
+@dataclass
+class FrameRequest:
+    """One admitted frame of one stream, queued for dispatch."""
+
+    request_id: int
+    stream_id: str
+    slot: int
+    frame_index: int
+    image1: np.ndarray
+    image2: np.ndarray
+    cold: bool  # forced cold start (first frame / gap > max_frame_gap)
+    submit_time: float
+    pad_spec: tuple
+    shape_key: Tuple[int, int]  # padded (H, W): AdmissionQueue's key_fn
+
+
+@dataclass(eq=False)
+class StreamStats:
+    """Per-run streaming accounting (ServeStats' note_*-only discipline:
+    submit callers, the dispatcher, and the drain worker all write)."""
+
+    submitted: int = 0
+    accepted: int = 0
+    completed: int = 0
+    shed_streams: int = 0  # stream admission refused (table full)
+    shed_frames: int = 0  # frame admission refused (queue full/draining)
+    rejected: int = 0  # malformed frames (admission-time validation)
+    resets: int = 0  # in-graph anomaly cold-start resets delivered
+    errors: int = 0
+    batches: int = 0
+    padded_rows: int = 0
+    streams_opened: int = 0
+    streams_closed: int = 0
+    streams_evicted: int = 0
+    cold_starts: int = 0  # frames dispatched cold (first/gap/reset-next)
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False
+    )
+
+    def note(self, field_name: str, delta: int = 1) -> None:
+        with self._lock:
+            setattr(self, field_name, getattr(self, field_name) + delta)
+
+    def summary(self) -> str:
+        return (
+            f"submitted={self.submitted} accepted={self.accepted} "
+            f"completed={self.completed} shed_streams={self.shed_streams} "
+            f"shed_frames={self.shed_frames} rejected={self.rejected} "
+            f"resets={self.resets} errors={self.errors} "
+            f"batches={self.batches} padded_rows={self.padded_rows} "
+            f"opened={self.streams_opened} closed={self.streams_closed} "
+            f"evicted={self.streams_evicted} cold_starts={self.cold_starts}"
+        )
+
+
+class StreamEngine:
+    """Serve many concurrent video streams against one model + variables.
+
+    ``clock`` is injectable (tests drive idle eviction and chaos
+    schedules deterministically); it must be monotonic. The engine owns
+    one dispatcher thread from construction until :meth:`drain`.
+    """
+
+    def __init__(
+        self,
+        model,
+        variables: dict,
+        cfg: Optional[StreamConfig] = None,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.cfg = cfg or StreamConfig()
+        self._clock = clock
+        self.stats = StreamStats()
+        h, w = self.cfg.frame_hw
+        padder = InputPadder(
+            (int(h), int(w), 3), mode="sintel", bucket=self.cfg.pad_bucket
+        )
+        (t, b), (le, r) = padder.pad_spec
+        self._ph, self._pw = int(h) + t + b, int(w) + le + r
+        self._h8, self._w8 = self._ph // 8, self._pw // 8
+        self._hidden = (
+            model.cfg.hidden_dim if self.cfg.carry_net else 0
+        )
+        # The device slot table. Owned by the dispatcher thread after
+        # construction: every step call donates it and replaces the
+        # reference with the program's output, so exactly one live copy
+        # exists in HBM.
+        self._table = init_slot_table(
+            self.cfg.capacity, self._h8, self._w8, self._hidden
+        )
+        # Serializes every step invocation that donates the table: the
+        # dispatcher owns it in steady state, but warmup() also runs
+        # step programs — two concurrent donors of the same buffer
+        # would be a use-after-donate.
+        self._table_lock = threading.Lock()
+        self._fwd = ShapeCachedForward(
+            model, variables, cache_size=self.cfg.cache_size
+        )
+        self._queue = AdmissionQueue(self.cfg.queue_capacity)
+        self._throttle = DispatchThrottle(self.cfg.inflight)
+        self._drainer = AsyncDrain(depth=self.cfg.drain_depth)
+        self.registry = SlotRegistry(self.cfg.capacity)
+        self._reg_lock = threading.Lock()
+        self._handles: dict[int, ServeHandle] = {}
+        self._inflight: dict[int, list] = {}  # drain-failure safety net
+        self._inflight_seq = 0
+        self._inflight_lock = threading.Lock()
+        self._service_ema: Optional[float] = None
+        self._ema_lock = threading.Lock()
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+        self._occupancy_sum = 0  # sampled at each dispatched batch
+        self._draining = threading.Event()
+        self._drained = False
+        self._thread = threading.Thread(
+            target=self._dispatch_loop, name="stream-dispatch", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------ admission
+
+    def submit(
+        self,
+        stream_id: str,
+        image1,
+        image2,
+        *,
+        frame_index: Optional[int] = None,
+    ) -> ServeHandle:
+        """Submit the next frame pair of ``stream_id``; returns a handle.
+
+        An unknown stream id is admitted on first use (slot allocation,
+        possibly shedding). ``frame_index`` defaults to
+        last-admitted + 1; explicit indices must be strictly increasing
+        per stream, and a gap beyond ``max_frame_gap`` forces a cold
+        start (stale warm state is never used).
+        """
+        self.stats.note("submitted")
+        handle = ServeHandle()
+        with self._id_lock:
+            rid = self._next_id
+            self._next_id += 1
+        if self._draining.is_set():
+            self.stats.note("shed_frames")
+            handle.complete(FlowResponse(
+                rid, STATUS_SHED, retry_after_s=self._retry_after(),
+                detail="draining",
+            ))
+            return handle
+        err = self._frame_error(image1) or self._frame_error(image2)
+        if err is None and image1.shape != image2.shape:
+            err = f"frame shapes differ: {image1.shape} vs {image2.shape}"
+        if err is not None:
+            self.stats.note("rejected")
+            handle.complete(FlowResponse(rid, STATUS_REJECTED, detail=err))
+            return handle
+
+        now = self._clock()
+        native_hw = (int(image1.shape[0]), int(image1.shape[1]))
+        with self._reg_lock:
+            state = self.registry.get(stream_id)
+            if state is None:
+                evicted = self.registry.evict_expired(
+                    now, self.cfg.idle_timeout_s
+                )
+                for s in evicted:
+                    self.stats.note("streams_evicted")
+                state = self.registry.admit(stream_id, native_hw, now)
+                if state is None:
+                    self.stats.note("shed_streams")
+                    hint = self.registry.soonest_expiry_s(
+                        now, self.cfg.idle_timeout_s
+                    )
+                    handle.complete(FlowResponse(
+                        rid, STATUS_SHED,
+                        retry_after_s=round(hint, 4),
+                        detail="stream table full",
+                    ))
+                    return handle
+                self.stats.note("streams_opened")
+            if state.native_hw != native_hw:
+                self.stats.note("rejected")
+                handle.complete(FlowResponse(
+                    rid, STATUS_REJECTED,
+                    detail=(
+                        f"stream {stream_id!r} is {state.native_hw}, "
+                        f"got frame {native_hw}"
+                    ),
+                ))
+                return handle
+            if state.closing:
+                self.stats.note("shed_frames")
+                handle.complete(FlowResponse(
+                    rid, STATUS_SHED, detail="stream closing",
+                ))
+                return handle
+            last = state.last_frame_index
+            idx = frame_index if frame_index is not None else (
+                0 if last is None else last + 1
+            )
+            if last is not None and idx <= last:
+                self.stats.note("rejected")
+                handle.complete(FlowResponse(
+                    rid, STATUS_REJECTED,
+                    detail=(
+                        f"out-of-order frame index {idx} (last admitted "
+                        f"{last}) for stream {stream_id!r}"
+                    ),
+                ))
+                return handle
+            cold = last is None or (idx - last) > self.cfg.max_frame_gap
+            req = FrameRequest(
+                request_id=rid,
+                stream_id=stream_id,
+                slot=state.slot,
+                frame_index=idx,
+                image1=image1,
+                image2=image2,
+                cold=cold,
+                submit_time=now,
+                pad_spec=self._pad_spec_for(native_hw),
+                shape_key=(self._ph, self._pw),
+            )
+            self._handles[rid] = handle
+            if not self._queue.offer(req):
+                self._handles.pop(rid, None)
+                self.stats.note("shed_frames")
+                handle.complete(FlowResponse(
+                    rid, STATUS_SHED, retry_after_s=self._retry_after(),
+                    detail="frame queue full",
+                ))
+                return handle
+            # Admission bookkeeping only after the offer sticks: a shed
+            # frame must not advance the stream's index or keep it warm.
+            state.last_frame_index = idx
+            state.last_activity = now
+            state.pending += 1
+            state.frames_admitted += 1
+        if cold:
+            self.stats.note("cold_starts")
+        self.stats.note("accepted")
+        return handle
+
+    def close_stream(self, stream_id: str) -> bool:
+        """Stop admitting frames for ``stream_id``; its slot frees once
+        everything already admitted has been answered. Returns False for
+        an unknown stream."""
+        with self._reg_lock:
+            state = self.registry.get(stream_id)
+            if state is None:
+                return False
+            state.closing = True
+            if state.pending == 0:
+                self.registry.release(stream_id)
+                self.stats.note("streams_closed")
+        return True
+
+    def _frame_error(self, image) -> Optional[str]:
+        shape = getattr(image, "shape", None)
+        dtype = getattr(image, "dtype", None)
+        if shape is None or dtype is None:
+            return f"not an array: {type(image).__name__}"
+        if len(shape) != 3 or shape[-1] != 3:
+            return f"want (H, W, 3), got shape {tuple(shape)}"
+        if np.dtype(dtype).kind not in "uif":
+            return f"non-numeric dtype {dtype}"
+        h, w = int(shape[0]), int(shape[1])
+        padder = InputPadder(
+            (h, w, 3), mode="sintel", bucket=self.cfg.pad_bucket
+        )
+        (t, b), (le, r) = padder.pad_spec
+        if (h + t + b, w + le + r) != (self._ph, self._pw):
+            return (
+                f"frame {h}x{w} pads to {(h + t + b, w + le + r)}, but "
+                f"this engine serves the {(self._ph, self._pw)} slot "
+                "table (one padded shape per engine)"
+            )
+        return None
+
+    def _pad_spec_for(self, native_hw: Tuple[int, int]) -> tuple:
+        h, w = native_hw
+        return InputPadder(
+            (h, w, 3), mode="sintel", bucket=self.cfg.pad_bucket
+        ).pad_spec
+
+    def _retry_after(self) -> float:
+        with self._ema_lock:
+            per_frame = self._service_ema
+        if per_frame is None:
+            return self.cfg.default_retry_after_s
+        return round((len(self._queue) + 1) * per_frame, 4)
+
+    # ------------------------------------------------------------- dispatch
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            batch = self._queue.pop_batch(
+                self.cfg.max_batch,
+                timeout=_POLL_S,
+                distinct_fn=lambda r: r.stream_id,
+            )
+            if not batch:
+                if self._queue.closed and not len(self._queue):
+                    return
+                # Idle tick: abandoned streams lose their slots even
+                # when no new admission forces the scan.
+                with self._reg_lock:
+                    evicted = self.registry.evict_expired(
+                        self._clock(), self.cfg.idle_timeout_s
+                    )
+                for _ in evicted:
+                    self.stats.note("streams_evicted")
+                continue
+            try:
+                self._process(batch)
+            except BaseException as e:  # noqa: BLE001 — per-frame status
+                # Server-side fault (XLA error, drain-worker failure):
+                # every still-pending frame in this batch answers
+                # `error`; stranded in-flight batches are flushed from
+                # the registry (AsyncDrain surfaces worker errors on a
+                # LATER submit). The engine keeps serving.
+                self._fail_inflight(e)
+                for req in batch:
+                    if self._complete(req.request_id, FlowResponse(
+                        req.request_id, STATUS_ERROR, detail=repr(e),
+                    )):
+                        self._finish_frame(req)
+                        self.stats.note("errors")
+
+    def _step(self, n_rows: int):
+        """The compiled slot-table step for one batch size (compiled
+        once per size; ``ShapeCachedForward.custom`` accounts it)."""
+        cfg = self.cfg
+        model = self._fwd.model
+
+        def build():
+            import jax
+            import jax.numpy as jnp
+
+            from raft_ncup_tpu.ops.warmstart import (
+                forward_interpolate_batch,
+            )
+
+            iters, thresh = cfg.iters, cfg.anomaly_max_flow
+            carry_net = bool(self._hidden)
+
+            def fn(v, table, img1, img2, slot_idx, cold):
+                prev_flow = table["flow"][slot_idx]  # (B, h8, w8, 2)
+                warm = (
+                    table["warm"][slot_idx] * (1.0 - cold) > 0.5
+                )  # (B,) bool
+                splat = forward_interpolate_batch(
+                    prev_flow, cfg.splat_chunk
+                )
+                finit = jnp.where(
+                    warm[:, None, None, None], splat,
+                    jnp.zeros_like(splat),
+                )
+                kwargs = {}
+                if carry_net:
+                    kwargs = {
+                        "net_init": table["net"][slot_idx],
+                        "net_warm": warm,
+                    }
+                flow_lr, flow_up, net_f = model.apply(
+                    v, img1, img2, iters=iters, flow_init=finit,
+                    test_mode=True, return_net=True, **kwargs,
+                )
+                # In-graph anomaly: a non-finite or diverged row resets
+                # ITS slot to cold; batch-mates' rows are untouched.
+                bad = (
+                    ~jnp.isfinite(flow_lr).all(axis=(1, 2, 3))
+                    | ~jnp.isfinite(flow_up).all(axis=(1, 2, 3))
+                    | (jnp.abs(flow_lr).max(axis=(1, 2, 3)) > thresh)
+                )
+                good = ~bad
+                gm = good[:, None, None, None]
+                new_table = dict(table)
+                new_table["flow"] = table["flow"].at[slot_idx].set(
+                    jnp.where(gm, flow_lr, jnp.zeros_like(flow_lr))
+                )
+                new_table["warm"] = table["warm"].at[slot_idx].set(
+                    good.astype(jnp.float32)
+                )
+                if carry_net:
+                    netf = net_f.astype(jnp.float32)
+                    new_table["net"] = table["net"].at[slot_idx].set(
+                        jnp.where(gm, netf, jnp.zeros_like(netf))
+                    )
+                return new_table, flow_up, bad
+
+            # Donate the slot table: the step's scatter updates it in
+            # place, so exactly one table lives in HBM.
+            return jax.jit(fn, donate_argnums=(1,))
+
+        return self._fwd.custom(("stream", n_rows), build)
+
+    def _process(self, batch: list) -> None:
+        import jax.numpy as jnp
+
+        n_rows = next(
+            b for b in self.cfg.batch_sizes if b >= len(batch)
+        )
+        pad_rows = n_rows - len(batch)
+        rows1 = [self._stage(r.image1, r.pad_spec) for r in batch]
+        rows2 = [self._stage(r.image2, r.pad_spec) for r in batch]
+        slot_idx = [r.slot for r in batch]
+        cold = [1.0 if r.cold else 0.0 for r in batch]
+        scratch = self.cfg.capacity
+        for _ in range(pad_rows):
+            rows1.append(np.zeros((self._ph, self._pw, 3), np.float32))
+            rows2.append(np.zeros((self._ph, self._pw, 3), np.float32))
+            slot_idx.append(scratch)
+            cold.append(1.0)
+        self.stats.note("batches")
+        self.stats.note("padded_rows", pad_rows)
+        with self._reg_lock:
+            self._occupancy_sum += self.registry.occupancy
+
+        t_dispatch = self._clock()
+        step = self._step(n_rows)
+        with self._table_lock:
+            self._table, flow_up, bad = step(
+                self._fwd.variables,
+                self._table,
+                jnp.asarray(np.stack(rows1)),
+                jnp.asarray(np.stack(rows2)),
+                jnp.asarray(np.asarray(slot_idx, np.int32)),
+                jnp.asarray(np.asarray(cold, np.float32)),
+            )
+        self._throttle.push(flow_up)
+        with self._inflight_lock:
+            token = self._inflight_seq
+            self._inflight_seq += 1
+            self._inflight[token] = batch
+
+        def deliver(host, batch=batch, token=token):
+            with self._inflight_lock:
+                self._inflight.pop(token, None)
+            host_flow, host_bad = host
+            done = self._clock()
+            for k, req in enumerate(batch):
+                bad = bool(host_bad[k])
+                if bad:
+                    resp = FlowResponse(
+                        req.request_id, STATUS_REJECTED,
+                        latency_s=done - req.submit_time,
+                        detail=(
+                            "in-graph anomaly: stream reset to cold "
+                            "start"
+                        ),
+                    )
+                else:
+                    (t, b), (le, r) = req.pad_spec
+                    hh, ww = host_flow.shape[1], host_flow.shape[2]
+                    resp = FlowResponse(
+                        req.request_id, STATUS_OK,
+                        flow=host_flow[k, t: hh - b, le: ww - r, :],
+                        iters=self.cfg.iters,
+                        latency_s=done - req.submit_time,
+                    )
+                # Gate ALL per-frame bookkeeping on the completion
+                # actually happening: if a server-side failure already
+                # flushed this frame (_fail_inflight answered it with
+                # `error`), finishing it again here would double-
+                # decrement the stream's pending count — and a
+                # pending==0 misread frees a slot whose stream still
+                # has queued frames.
+                if not self._complete(req.request_id, resp):
+                    continue
+                self._finish_frame(req, reset=bad)
+                self.stats.note("resets" if bad else "completed")
+            self._note_service(
+                (done - t_dispatch) / max(1, len(batch))
+            )
+
+        # The batch's ONE sanctioned pull: full flow + B anomaly flags.
+        self._drainer.submit((flow_up, bad), deliver)
+
+    def _finish_frame(self, req: FrameRequest, reset: bool = False) -> None:
+        """Per-frame terminal bookkeeping: pending counts, deferred
+        close-release, activity refresh, reset accounting."""
+        with self._reg_lock:
+            state = self.registry.get(req.stream_id)
+            if state is None:
+                return
+            state.pending = max(0, state.pending - 1)
+            state.frames_completed += 1
+            if reset:
+                state.resets += 1
+            if state.closing and state.pending == 0:
+                self.registry.release(req.stream_id)
+                self.stats.note("streams_closed")
+
+    def _fail_inflight(self, exc: BaseException) -> None:
+        with self._inflight_lock:
+            stranded = list(self._inflight.values())
+            self._inflight.clear()
+        for batch in stranded:
+            for req in batch:
+                if self._complete(req.request_id, FlowResponse(
+                    req.request_id, STATUS_ERROR,
+                    detail=f"result drain failed: {exc!r}",
+                )):
+                    self._finish_frame(req)
+                    self.stats.note("errors")
+
+    def _stage(self, image, pad_spec) -> np.ndarray:
+        (t, b), (le, r) = pad_spec
+        arr = np.asarray(image, np.float32)
+        if t or b or le or r:
+            arr = np.pad(arr, ((t, b), (le, r), (0, 0)), mode="edge")
+        return arr
+
+    def _complete(self, rid: int, response: FlowResponse) -> bool:
+        handle = self._handles.pop(rid, None)
+        if handle is None:
+            return False
+        handle.complete(response)
+        return True
+
+    def _note_service(self, per_frame_s: float) -> None:
+        with self._ema_lock:
+            prev = self._service_ema
+            self._service_ema = (
+                per_frame_s if prev is None
+                else 0.8 * prev + 0.2 * per_frame_s
+            )
+
+    # ------------------------------------------------------------ lifecycle
+
+    def warmup(self) -> int:
+        """Compile the whole executable set (one step program per batch
+        size) against the scratch slot. Returns programs compiled.
+        Pausing the queue keeps NEW batches from assembling; the table
+        lock is what makes warmup safe against a batch the dispatcher
+        had already popped before the pause landed — both donate the
+        slot table, and two concurrent donors of one buffer is a
+        use-after-donate."""
+        import jax
+
+        before = self._fwd.stats["compiles"]
+        self._queue.set_paused(True)
+        try:
+            import jax.numpy as jnp
+
+            scratch = self.cfg.capacity
+            for n in self.cfg.batch_sizes:
+                zeros = np.zeros(
+                    (n, self._ph, self._pw, 3), np.float32
+                )
+                step = self._step(n)
+                with self._table_lock:
+                    self._table, flow_up, bad = step(
+                        self._fwd.variables,
+                        self._table,
+                        jnp.asarray(zeros),
+                        jnp.asarray(zeros),
+                        jnp.asarray(
+                            np.full((n,), scratch, np.int32)
+                        ),
+                        jnp.asarray(np.ones((n,), np.float32)),
+                    )
+                jax.block_until_ready((self._table, flow_up, bad))
+        finally:
+            self._queue.set_paused(False)
+        return self._fwd.stats["compiles"] - before
+
+    def pause(self) -> None:
+        """Test/ops hook: stop assembling new batches (queued and new
+        frames wait). Deterministic, see AdmissionQueue.set_paused."""
+        self._queue.set_paused(True)
+
+    def resume(self) -> None:
+        self._queue.set_paused(False)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def drain(self, timeout: Optional[float] = None) -> StreamStats:
+        """Graceful drain: stop admitting, flush every admitted frame,
+        tear down, return final stats. Idempotent."""
+        self._draining.set()
+        self._queue.close()  # clears any pause: drain must finish
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError(
+                    f"stream dispatcher did not drain within {timeout}s "
+                    f"({len(self._queue)} frames still queued)"
+                )
+        if not self._drained:
+            self._drained = True
+            self._throttle.drain()
+            try:
+                self._drainer.close()
+            except Exception as e:
+                import sys
+
+                print(
+                    f"stream drain worker failed: {e!r}", file=sys.stderr
+                )
+                self._fail_inflight(e)
+        return self.stats
+
+    def report(self) -> dict:
+        """One JSON-able summary: stats + slot-table occupancy +
+        executable accounting."""
+        with self._reg_lock:
+            occupancy = self.registry.occupancy
+            peak = self.registry.peak_occupancy
+            evicted = self.registry.evicted_total
+        batches = max(1, self.stats.batches)
+        return {
+            "stats": self.stats.summary(),
+            "capacity": self.cfg.capacity,
+            "occupancy": occupancy,
+            "peak_occupancy": peak,
+            "mean_occupancy": round(self._occupancy_sum / batches, 2),
+            "evicted": evicted,
+            "executables": dict(self._fwd.stats),
+        }
+
+    def __enter__(self) -> "StreamEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.drain()

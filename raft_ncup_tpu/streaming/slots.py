@@ -1,0 +1,165 @@
+"""Slot-table state for the multi-stream engine: device arrays + host
+registry.
+
+The split of responsibilities is the whole design:
+
+- **Device** (:func:`init_slot_table`): the recurrent state itself —
+  per-slot previous low-res flow, a warm flag, and (``carry_net``) the
+  GRU hidden state — lives in fixed-shape HBM arrays of size
+  ``capacity + 1``. It is read (gather by slot index) and written
+  (scatter) ONLY inside the jitted stream step
+  (``streaming/engine.py``), so state never crosses to host between
+  frames. Index ``capacity`` is the **scratch slot**: zero-padded batch
+  rows gather from and scatter to it, so padding can never touch a real
+  stream's state. The warm flag lives on DEVICE, not in the registry,
+  because the in-graph anomaly check flips it (reset-to-cold) without a
+  host round-trip — the host learns about a reset asynchronously from
+  the drained flags, but the next frame of that stream already reads
+  the reset state correctly.
+
+- **Host** (:class:`SlotRegistry`): pure bookkeeping — which stream
+  owns which slot, last admitted frame index (staleness), last activity
+  time (idle eviction), pending-frame counts (eviction safety). All of
+  it is cheap metadata; none of it is recurrent state. Slot allocation
+  and eviction are deterministic: the lowest-numbered free slot is
+  assigned, and idle eviction scans in (last_activity, stream_id)
+  order — a replayed chaos schedule evicts the same streams into the
+  same slots. Freeing a slot touches NO device memory: the next owner's
+  first frame dispatches with ``cold=1``, which both ignores and
+  overwrites whatever the previous owner left, so slot reuse can never
+  recompile or transfer.
+
+Callers hold the engine's lock around registry calls; the registry
+itself is not locked (single-owner discipline, like ``ServeStats``
+note_* methods own their lock).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def init_slot_table(
+    capacity: int, h8: int, w8: int, hidden_dim: int = 0
+) -> dict:
+    """Fresh all-cold device slot table for ``capacity`` streams.
+
+    Arrays are sized ``capacity + 1``: the extra row is the scratch slot
+    batch padding targets. ``warm`` is float32 0/1 (it multiplies into
+    masks in-graph); everything starts cold, so a freshly admitted
+    stream's first frame is bitwise a cold start regardless of history.
+    """
+    table = {
+        "flow": jnp.zeros((capacity + 1, h8, w8, 2), jnp.float32),
+        "warm": jnp.zeros((capacity + 1,), jnp.float32),
+    }
+    if hidden_dim:
+        table["net"] = jnp.zeros(
+            (capacity + 1, h8, w8, hidden_dim), jnp.float32
+        )
+    return table
+
+
+@dataclass
+class StreamState:
+    """Host-side metadata for one admitted stream (one slot)."""
+
+    stream_id: str
+    slot: int
+    native_hw: Tuple[int, int]
+    opened_at: float
+    last_activity: float
+    last_frame_index: Optional[int] = None
+    pending: int = 0  # admitted frames not yet terminally answered
+    frames_admitted: int = 0
+    frames_completed: int = 0
+    resets: int = 0  # in-graph anomaly cold-start resets observed
+    closing: bool = False
+
+
+@dataclass
+class SlotRegistry:
+    """Host bookkeeping: stream_id -> slot assignment and lifecycle."""
+
+    capacity: int
+    streams: Dict[str, StreamState] = field(default_factory=dict)
+    evicted_total: int = 0
+    peak_occupancy: int = 0
+    _free: List[int] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        self._free = sorted(range(self.capacity), reverse=True)
+
+    # ------------------------------------------------------------ queries
+
+    def get(self, stream_id: str) -> Optional[StreamState]:
+        return self.streams.get(stream_id)
+
+    @property
+    def occupancy(self) -> int:
+        return self.capacity - len(self._free)
+
+    def soonest_expiry_s(self, now: float, idle_timeout_s: float) -> float:
+        """Honest retry hint for a shed stream admission: seconds until
+        the earliest-idle stream becomes evictable (0 when a slot is
+        already reclaimable)."""
+        if not self.streams:
+            return idle_timeout_s
+        remaining = [
+            max(0.0, s.last_activity + idle_timeout_s - now)
+            for s in self.streams.values()
+        ]
+        return min(remaining)
+
+    # ---------------------------------------------------------- lifecycle
+
+    def admit(
+        self, stream_id: str, native_hw: Tuple[int, int], now: float
+    ) -> Optional[StreamState]:
+        """Assign the lowest free slot to a new stream, or ``None`` when
+        the table is full (the caller sheds)."""
+        if not self._free:
+            return None
+        state = StreamState(
+            stream_id=stream_id,
+            slot=self._free.pop(),
+            native_hw=tuple(native_hw),
+            opened_at=now,
+            last_activity=now,
+        )
+        self.streams[stream_id] = state
+        self.peak_occupancy = max(self.peak_occupancy, self.occupancy)
+        return state
+
+    def release(self, stream_id: str) -> Optional[int]:
+        """Free a stream's slot (close or eviction); returns the slot."""
+        state = self.streams.pop(stream_id, None)
+        if state is None:
+            return None
+        self._free.append(state.slot)
+        self._free.sort(reverse=True)  # keep lowest-slot-first assignment
+        return state.slot
+
+    def evict_expired(
+        self, now: float, idle_timeout_s: float
+    ) -> List[StreamState]:
+        """Evict every idle-expired stream with nothing in flight.
+
+        Deterministic order (oldest activity first, stream_id breaking
+        ties) so a replayed chaos run reassigns identical slots."""
+        expired = sorted(
+            (
+                s
+                for s in self.streams.values()
+                if s.pending == 0
+                and now - s.last_activity > idle_timeout_s
+            ),
+            key=lambda s: (s.last_activity, s.stream_id),
+        )
+        for s in expired:
+            self.release(s.stream_id)
+            self.evicted_total += 1
+        return expired

@@ -1,0 +1,152 @@
+"""Deterministic multi-stream frame schedule: the open-loop traffic the
+streaming chaos tests and the ``stream_*`` bench row drive the engine
+with.
+
+A schedule is fully determined by ``(seed, n_streams, frames_per_stream,
+interval_s, chaos)``. Frames are emitted round-robin across streams
+(frame f of every stream before frame f+1 of any) so co-batched streams
+stay co-batched — the composition the isolation tests pin bitwise.
+Chaos events address **schedule-slot indices**: stream ``s``'s frame
+``f`` is slot ``f * n_streams + s`` whether or not it is emitted, so a
+coordinate is stable under other chaos events (an ``abandon`` does not
+renumber later slots — an event landing on a slot the abandoned stream
+no longer emits is deliberately inert, never silently displaced onto a
+different stream's frame):
+
+- ``corruptframe@N`` — frame ``N``'s first image is all-NaN float32 →
+  the engine's in-graph anomaly check must reset only the owning
+  stream's slot.
+- ``abandon@N`` — the stream owning frame ``N`` emits nothing after it
+  (no close): the abandonment idle eviction must clean up.
+- ``burst@N`` — at frame ``N``'s due time, ``burst_size`` extra
+  single-frame streams (``burst-k``) arrive → stream admission must
+  shed the overflow.
+- ``sigterm@N`` — :func:`replay_streams` delivers a real SIGTERM after
+  submitting ``N`` frames (the graceful-drain contract mid-window).
+
+Per-stream content comes from ``data/synthetic.SyntheticFlowDataset``
+seeded by ``(seed, stream)``, so streams are distinct but replayable.
+"""
+
+from __future__ import annotations
+
+import os
+import signal as signal_mod
+import time
+from typing import Callable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from raft_ncup_tpu.data.synthetic import SyntheticFlowDataset
+from raft_ncup_tpu.resilience.chaos import ChaosSpec
+
+
+class StreamTraffic:
+    """Deterministic open-loop multi-stream schedule.
+
+    Iterating yields ``(due_s, stream_id, frame_index, image1, image2)``
+    ordered by due time. ``interval_s`` is the gap between consecutive
+    frame emissions (across all streams).
+    """
+
+    def __init__(
+        self,
+        size_hw: Tuple[int, int],
+        n_streams: int,
+        frames_per_stream: int,
+        *,
+        seed: int = 0,
+        interval_s: float = 0.0,
+        burst_size: int = 4,
+        chaos: Optional[ChaosSpec] = None,
+        style: str = "smooth",
+    ):
+        self.size_hw = tuple(size_hw)
+        self.n_streams = int(n_streams)
+        self.frames_per_stream = int(frames_per_stream)
+        self.interval_s = float(interval_s)
+        self.burst_size = max(1, int(burst_size))
+        self.chaos = chaos or ChaosSpec()
+        self._ds = [
+            SyntheticFlowDataset(
+                self.size_hw,
+                length=max(1, self.frames_per_stream),
+                seed=seed * 1000 + s,
+                style=style,
+            )
+            for s in range(self.n_streams + 1)
+        ]  # dataset n_streams feeds burst streams
+
+    def stream_id(self, s: int) -> str:
+        return f"stream-{s}"
+
+    def __iter__(
+        self,
+    ) -> Iterator[Tuple[float, str, int, np.ndarray, np.ndarray]]:
+        abandoned: set = set()
+        burst_emitted = 0
+        g = -1
+        for f in range(self.frames_per_stream):
+            for s in range(self.n_streams):
+                g += 1
+                due = g * self.interval_s
+                if s not in abandoned:
+                    sample = self._ds[s].sample(f)
+                    img1, img2 = sample["image1"], sample["image2"]
+                    if g in self.chaos.corrupt_frames:
+                        img1 = np.full(img1.shape, np.nan, np.float32)
+                    if g in self.chaos.abandon_frames:
+                        abandoned.add(s)
+                    yield due, self.stream_id(s), f, img1, img2
+                if g in self.chaos.burst_requests:
+                    # A thundering herd of new one-frame streams ON TOP
+                    # of the steady schedule (after the steady frame, so
+                    # established streams keep their slots and the
+                    # overflow is what sheds).
+                    for _ in range(self.burst_size):
+                        sample = self._ds[self.n_streams].sample(
+                            burst_emitted % self.frames_per_stream
+                        )
+                        burst_emitted += 1
+                        yield (
+                            due,
+                            f"burst-{burst_emitted - 1}",
+                            0,
+                            sample["image1"],
+                            sample["image2"],
+                        )
+
+
+def replay_streams(
+    engine,
+    traffic: StreamTraffic,
+    *,
+    preempt=None,
+    sigterm_after: Optional[int] = None,
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+) -> Tuple[List, bool]:
+    """Drive ``engine`` with ``traffic`` open-loop; returns
+    ``(handles, interrupted)``.
+
+    Open-loop: frames submit at their due times regardless of
+    completions — the engine's admission control is what bounds the
+    queue. ``preempt`` is an installed ``PreemptionHandler``; once its
+    flag is set the driver stops submitting immediately and the caller
+    invokes ``engine.drain()`` for the flush (``serving/traffic.replay``'s
+    contract, per frame instead of per request).
+    """
+    handles: List = []
+    t0 = clock()
+    for due, stream_id, frame_index, img1, img2 in traffic:
+        if preempt is not None and preempt.requested:
+            return handles, True
+        delay = due - (clock() - t0)
+        if delay > 0:
+            sleep(delay)
+        handles.append(
+            engine.submit(stream_id, img1, img2, frame_index=frame_index)
+        )
+        if sigterm_after is not None and len(handles) == sigterm_after:
+            os.kill(os.getpid(), signal_mod.SIGTERM)
+    return handles, bool(preempt is not None and preempt.requested)

@@ -1,3 +1,7 @@
-from raft_ncup_tpu.viz.flow_viz import flow_to_image, make_colorwheel
+from raft_ncup_tpu.viz.flow_viz import (
+    flow_to_color,
+    flow_to_image,
+    make_colorwheel,
+)
 
-__all__ = ["flow_to_image", "make_colorwheel"]
+__all__ = ["flow_to_color", "flow_to_image", "make_colorwheel"]

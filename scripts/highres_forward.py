@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Execute ONE real 1088x1920 / 32-iteration test-mode forward and report
+peak RSS + wall time — the out-of-band evidence behind docs/PERF.md's
+"1080p executed for real" row.
+
+tests/test_highres.py pins the 1080p memory story with *compiler memory
+analysis* (platform-independent, cheap); this script is the complement:
+it actually executes the flagship onthefly-corr configuration at full
+1080p shape and measures what the OS saw. CPU is an honest stand-in for
+"does the working set fit": ru_maxrss upper-bounds the XLA temp +
+argument + output footprint the analysis predicts (host arenas and the
+compiler itself add overhead on top, which is why both numbers are
+recorded side by side).
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/highres_forward.py [--iters 32]
+        [--size 1088 1920] [--corr_impl onthefly]
+
+Prints one JSON line: shape, iters, compile_s, run_s (the executed
+forward, compile excluded), peak_rss_gib, memory-analysis bytes for the
+same executable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--size", type=int, nargs=2, default=[1088, 1920],
+                   metavar=("H", "W"))
+    p.add_argument("--iters", type=int, default=32)
+    p.add_argument("--corr_impl", default="onthefly",
+                   choices=["onthefly", "volume", "pallas"])
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_ncup_tpu.config import flagship_config
+    from raft_ncup_tpu.models import get_model
+
+    h, w = args.size
+    cfg = flagship_config(dataset="sintel", corr_impl=args.corr_impl)
+    model = get_model(cfg)
+    variables = model.init(jax.random.PRNGKey(0), (1, 64, 64, 3))
+
+    def fwd(v, i1, i2):
+        return model.apply(v, i1, i2, iters=args.iters, test_mode=True)
+
+    img = jax.ShapeDtypeStruct((1, h, w, 3), jnp.float32)
+    t0 = time.perf_counter()
+    compiled = jax.jit(fwd).lower(variables, img, img).compile()
+    compile_s = time.perf_counter() - t0
+    mem = compiled.memory_analysis()
+
+    rng = np.random.default_rng(0)
+    img1 = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)), jnp.float32)
+    img2 = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)), jnp.float32)
+    t0 = time.perf_counter()
+    lr, up = compiled(variables, img1, img2)
+    jax.block_until_ready((lr, up))
+    run_s = time.perf_counter() - t0
+
+    finite = bool(jnp.isfinite(up).all())
+    # Linux ru_maxrss is KiB.
+    peak_rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    report = {
+        "shape": [1, h, w, 3],
+        "iters": args.iters,
+        "corr_impl": args.corr_impl,
+        "platform": jax.default_backend(),
+        "compile_s": round(compile_s, 1),
+        "run_s": round(run_s, 1),
+        "finite": finite,
+        "peak_rss_gib": round(peak_rss / 2**30, 2),
+        "analysis_temp_gib": round(
+            int(mem.temp_size_in_bytes) / 2**30, 2
+        ),
+        "analysis_total_gib": round(
+            (
+                int(mem.temp_size_in_bytes)
+                + int(mem.argument_size_in_bytes)
+                + int(mem.output_size_in_bytes)
+            )
+            / 2**30,
+            2,
+        ),
+    }
+    print(json.dumps(report), flush=True)
+    return 0 if finite else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

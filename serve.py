@@ -1,27 +1,39 @@
 #!/usr/bin/env python
-"""Flow-serving driver: run the online serving tier against a
-deterministic synthetic open-loop request stream.
+"""Flow-serving driver: run the online serving tier — or, with
+``--stream``, the streaming video engine — against a deterministic
+synthetic open-loop schedule.
 
 The serving analogue of train.py/evaluate.py (no reference counterpart —
-the reference has no serving story). Builds one model + variables set,
-stands up a :class:`raft_ncup_tpu.serving.FlowServer` (bounded admission
-queue, anytime iteration budget, poison quarantine), warms the full
-executable set, replays ``--num_requests`` synthetic requests at
-``--interval_ms``, then drains and prints ONE JSON report line
-(stats + latency percentiles + budget trajectory).
+the reference has no serving story). Default mode builds one model +
+variables set, stands up a :class:`raft_ncup_tpu.serving.FlowServer`
+(bounded admission queue, anytime iteration budget, poison quarantine),
+warms the full executable set, replays ``--num_requests`` synthetic
+requests at ``--interval_ms``, then drains and prints ONE JSON report
+line (stats + latency percentiles + budget trajectory).
 
-Graceful drain: SIGTERM/SIGINT (via ``resilience/preemption.py``) stops
-submissions immediately, every request already admitted is flushed
-through compute, and the process exits ``EXIT_PREEMPTED`` (75) — the
-clean re-runnable shutdown, distinct from success and crash. Chaos
-events (``--chaos "burst@8,poison@20,sigterm@40"``) drive the same
-machinery deterministically (docs/SERVING.md has the full matrix).
+``--stream`` mode stands up a
+:class:`raft_ncup_tpu.streaming.StreamEngine` instead (fixed-capacity
+slot table, device-resident warm start, per-stream fault isolation;
+docs/STREAMING.md) and replays ``--n_streams`` concurrent streams of
+``--frames_per_stream`` frames each.
+
+Graceful drain (both modes): SIGTERM/SIGINT (via
+``resilience/preemption.py``) stops submissions immediately, everything
+already admitted is flushed through compute, and the process exits
+``EXIT_PREEMPTED`` (75) — the clean re-runnable shutdown, distinct from
+success and crash. Chaos events drive the same machinery
+deterministically: ``--chaos "burst@8,poison@20,sigterm@40"`` for
+serving, ``--chaos "corruptframe@5,abandon@9,sigterm@20"`` for
+streaming (docs/SERVING.md and docs/STREAMING.md have the matrices).
 
 Examples:
     python serve.py --platform cpu --num_requests 32 --size 96 128 \
         --iter_levels 12,6 --serve_batch_sizes 1,2
     python serve.py --restore_ckpt checkpoints/raft_nc_sintel \
         --chaos "burst@16" --queue_capacity 32
+    python serve.py --platform cpu --stream --n_streams 3 \
+        --frames_per_stream 6 --size 96 128 --stream_iters 8 \
+        --chaos "corruptframe@7"
 """
 
 from __future__ import annotations
@@ -37,6 +49,7 @@ def build_parser() -> argparse.ArgumentParser:
         add_model_args,
         add_platform_arg,
         add_serve_args,
+        add_stream_args,
     )
 
     parser = argparse.ArgumentParser(
@@ -60,13 +73,103 @@ def build_parser() -> argparse.ArgumentParser:
                         choices=["smooth", "rigid"],
                         help="synthetic traffic content generator")
     parser.add_argument("--chaos", default=None,
-                        help="deterministic serving faults: comma-joined "
-                        "burst@N / poison@N / sigterm@N "
-                        "(resilience/chaos.py)")
+                        help="deterministic faults: comma-joined "
+                        "burst@N / poison@N / sigterm@N (serving) or "
+                        "corruptframe@N / abandon@N / burst@N / "
+                        "sigterm@N (--stream) — resilience/chaos.py")
+    parser.add_argument("--stream", action="store_true",
+                        help="drive the streaming video engine "
+                        "(raft_ncup_tpu/streaming/) instead of the "
+                        "request server")
+    parser.add_argument("--n_streams", type=int, default=4,
+                        help="[--stream] concurrent synthetic streams")
+    parser.add_argument("--frames_per_stream", type=int, default=8,
+                        help="[--stream] frames submitted per stream")
     add_serve_args(parser)
+    add_stream_args(parser)
     add_model_args(parser)
     add_platform_arg(parser)
     return parser
+
+
+def run_stream(args, model, variables) -> int:
+    """--stream mode: replay a deterministic multi-stream schedule
+    through the StreamEngine, drain, print one JSON report line."""
+    from raft_ncup_tpu.cli import stream_config_from_args
+    from raft_ncup_tpu.resilience import EXIT_PREEMPTED, PreemptionHandler
+    from raft_ncup_tpu.resilience.chaos import ChaosSpec
+    from raft_ncup_tpu.serving import nearest_rank_ms
+    from raft_ncup_tpu.streaming import (
+        StreamEngine,
+        StreamTraffic,
+        replay_streams,
+    )
+
+    chaos = ChaosSpec.parse(args.chaos)
+    if chaos.active:
+        print(f"chaos: {chaos.render()}", file=sys.stderr)
+    size_hw = (args.size[0], args.size[1])
+    stream_cfg = stream_config_from_args(args, size_hw)
+
+    engine = StreamEngine(model, variables, stream_cfg)
+    t0 = time.monotonic()
+    compiled = engine.warmup()
+    print(
+        f"warmup: {compiled} stream-step executables compiled in "
+        f"{time.monotonic() - t0:.1f}s "
+        f"(batch_sizes={stream_cfg.batch_sizes} "
+        f"iters={stream_cfg.iters})",
+        file=sys.stderr,
+    )
+    traffic = StreamTraffic(
+        size_hw,
+        args.n_streams,
+        args.frames_per_stream,
+        seed=args.seed,
+        interval_s=args.interval_ms / 1000.0,
+        burst_size=args.burst_size,
+        chaos=chaos,
+        style=args.style,
+    )
+    t0 = time.monotonic()
+    with PreemptionHandler() as preempt:
+        handles, interrupted = replay_streams(
+            engine, traffic, preempt=preempt,
+            sigterm_after=chaos.sigterm_after,
+        )
+        stats = engine.drain()
+    wall = time.monotonic() - t0
+
+    responses = [h.result(timeout=30.0) for h in handles]
+    lat = [
+        r.latency_s for r in responses if r.ok and r.latency_s is not None
+    ]
+    report = {
+        "stream_frames": len(handles),
+        "stream_ok": len(lat),
+        "stream_wall_s": round(wall, 3),
+        "stream_frames_per_sec": (
+            round(stats.completed / wall, 3) if wall > 0 else None
+        ),
+        "stream_p50_ms": nearest_rank_ms(lat, 0.50),
+        "stream_p99_ms": nearest_rank_ms(lat, 0.99),
+        "interrupted": interrupted,
+        "completed": stats.completed,
+        "resets": stats.resets,
+        "shed_streams": stats.shed_streams,
+        "shed_frames": stats.shed_frames,
+        "errors": stats.errors,
+        **engine.report(),
+    }
+    print(json.dumps(report), flush=True)
+    if interrupted:
+        print(
+            "stream: drained after signal — every admitted frame was "
+            "flushed; exiting EXIT_PREEMPTED",
+            file=sys.stderr,
+        )
+        return EXIT_PREEMPTED
+    return 0
 
 
 def main(argv=None) -> int:
@@ -88,13 +191,16 @@ def main(argv=None) -> int:
     )
 
     model_cfg = model_config_from_args(args)
+    model = RAFT(model_cfg)
+    variables = load_variables(model, model_cfg, args.restore_ckpt)
+    if args.stream:
+        return run_stream(args, model, variables)
+
     serve_cfg = serve_config_from_args(args)
     chaos = ChaosSpec.parse(args.chaos)
     if chaos.active:
         print(f"chaos: {chaos.render()}", file=sys.stderr)
 
-    model = RAFT(model_cfg)
-    variables = load_variables(model, model_cfg, args.restore_ckpt)
     size_hw = (args.size[0], args.size[1])
 
     server = FlowServer(model, variables, serve_cfg)

@@ -548,6 +548,54 @@ def test_jgl008_serving_dispatcher_in_scope(tmp_path):
     assert findings[0].qualname == "dispatch"
 
 
+def test_jgl008_streaming_dispatcher_in_scope(tmp_path):
+    """The streaming engine's dispatch loop is in scope: per-stream
+    recurrent state lives in the device slot table precisely so nothing
+    needs pulling between frames — a per-batch pull there reintroduces
+    the serialization the subsystem deletes."""
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def dispatch(queue, step, table):
+            while queue:
+                batch = queue.pop()
+                table, flow, bad = step(table, batch)
+                notify(jax.device_get(bad))
+        """,
+        name="raft_ncup_tpu/streaming/engine.py",
+    )
+    assert [f.rule for f in findings] == ["JGL008"]
+    assert findings[0].qualname == "dispatch"
+
+
+def test_jgl008_streaming_negative_device_resident_loop(tmp_path):
+    """The sanctioned streaming shape: the slot-table carry stays on
+    device across iterations, the bounded throttle syncs without
+    transferring, and the flow+flags pull rides a callback that runs on
+    the AsyncDrain worker (defined in the loop, executed off it)."""
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def dispatch(queue, step, table, throttle, drain):
+            while queue:
+                batch = queue.pop()
+                table, flow, bad = step(table, batch)
+                jax.block_until_ready(flow)
+
+                def deliver(host):
+                    complete(host)
+
+                drain.submit((flow, bad), deliver)
+        """,
+        name="raft_ncup_tpu/streaming/engine.py",
+    )
+    assert findings == []
+
+
 def test_jgl008_out_of_scope_paths_exempt(tmp_path):
     """The same per-iteration pull outside inference//evaluation.py is
     JGL001's business (when traced) or legitimate driver code."""

@@ -1,0 +1,226 @@
+"""Parity of the pure-JAX warm-start splat vs the host cKDTree version.
+
+The acceptance bar for deleting the eval loop's last sanctioned
+per-frame pull (ops/warmstart.py): ``forward_interpolate_jax`` must
+match ``forward_interpolate`` on dense and sparse-survivor flows
+(including the all-points-out-of-bounds ⇒ zeros path), and the Sintel
+warm-start validator must produce IDENTICAL EPE with the device splat
+swapped in for the host one.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_ncup_tpu.ops.warmstart import (
+    forward_interpolate,
+    forward_interpolate_batch,
+    forward_interpolate_jax,
+)
+
+
+def _jx(flow, **kw):
+    return np.asarray(forward_interpolate_jax(jnp.asarray(flow), **kw))
+
+
+class TestForwardInterpolateJaxParity:
+    def test_dense_small_flow_matches_host_bitwise(self):
+        """Smooth small flow: nearly every cell receives a splat; the
+        nearest fill only bridges sub-pixel gaps."""
+        g = np.random.default_rng(0)
+        flow = g.normal(0, 1.5, (20, 31, 2)).astype(np.float32)
+        np.testing.assert_array_equal(_jx(flow), forward_interpolate(flow))
+
+    def test_sparse_survivors_match_host_bitwise(self):
+        """Huge flow pushes most destinations out of bounds: the few
+        survivors fill large regions by genuine Euclidean nearest —
+        the case an iterated-dilation approximation would get wrong."""
+        g = np.random.default_rng(1)
+        flow = g.normal(0, 60.0, (16, 16, 2)).astype(np.float32)
+        host = forward_interpolate(flow)
+        # Fixture sanity: this really is the sparse regime.
+        x0, y0 = np.meshgrid(np.arange(16), np.arange(16))
+        x1 = (x0 + flow[..., 0]).ravel()
+        y1 = (y0 + flow[..., 1]).ravel()
+        valid = (x1 > 0) & (x1 < 16) & (y1 > 0) & (y1 < 16)
+        assert 0 < valid.sum() < 40
+        np.testing.assert_array_equal(_jx(flow), host)
+
+    def test_all_points_out_of_bounds_is_zeros(self):
+        flow = np.full((8, 8, 2), 1000.0, np.float32)
+        out = _jx(flow)
+        assert (out == 0).all()
+        np.testing.assert_array_equal(out, forward_interpolate(flow))
+
+    def test_zero_flow_is_zero(self):
+        flow = np.zeros((10, 12, 2), np.float32)
+        np.testing.assert_array_equal(_jx(flow), np.zeros_like(flow))
+
+    def test_strict_open_interval_bounds(self):
+        """Destinations exactly ON the boundary are dropped (the
+        reference's strict inequalities) — a flow moving everything to
+        x=0 must not survive."""
+        flow = np.zeros((6, 6, 2), np.float32)
+        x0, _ = np.meshgrid(np.arange(6), np.arange(6))
+        flow[..., 0] = -x0  # every destination lands exactly at x=0
+        host = forward_interpolate(flow)
+        np.testing.assert_array_equal(_jx(flow), host)
+        assert (host == 0).all()
+
+    def test_chunk_size_does_not_change_result(self):
+        g = np.random.default_rng(2)
+        flow = g.normal(0, 8.0, (12, 18, 2)).astype(np.float32)
+        full = _jx(flow, chunk=12 * 18)
+        np.testing.assert_array_equal(_jx(flow, chunk=7), full)
+        np.testing.assert_array_equal(_jx(flow, chunk=1), full)
+
+    def test_batch_rows_are_independent(self):
+        """vmap rows match the single-frame function — a NaN row cannot
+        leak into its batch-mates (the streaming isolation contract's
+        numerical foundation)."""
+        g = np.random.default_rng(3)
+        a = g.normal(0, 2.0, (16, 16, 2)).astype(np.float32)
+        b = g.normal(0, 50.0, (16, 16, 2)).astype(np.float32)
+        poison = np.full((16, 16, 2), np.nan, np.float32)
+        out = np.asarray(
+            forward_interpolate_batch(jnp.asarray(np.stack([a, poison, b])))
+        )
+        np.testing.assert_array_equal(out[0], _jx(a))
+        np.testing.assert_array_equal(out[2], _jx(b))
+
+    def test_traceable_under_jit_one_program_per_shape(self):
+        g = np.random.default_rng(4)
+        fn = jax.jit(lambda f: forward_interpolate_jax(f))
+        a = g.normal(0, 3.0, (8, 10, 2)).astype(np.float32)
+        b = g.normal(0, 3.0, (8, 10, 2)).astype(np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(fn(jnp.asarray(a))), forward_interpolate(a)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(fn(jnp.asarray(b))), forward_interpolate(b)
+        )
+
+    def test_rejects_bad_shape(self):
+        with pytest.raises(ValueError):
+            forward_interpolate_jax(jnp.zeros((4, 4, 3)))
+
+
+# -------------------------------------- warm-start validator EPE parity
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from raft_ncup_tpu.config import small_model_config
+    from raft_ncup_tpu.models import get_model
+
+    cfg = small_model_config("raft", dataset="chairs")
+    model = get_model(cfg)
+    variables = model.init(jax.random.PRNGKey(0), (1, 36, 44, 3))
+    return model, variables
+
+
+class _SeqDataset:
+    """Synthetic 'video': all frames belong to one sequence."""
+
+    def __init__(self, n, hw=(36, 44), seed=77):
+        from raft_ncup_tpu.data.synthetic import SyntheticFlowDataset
+
+        self._ds = SyntheticFlowDataset(hw, length=n, seed=seed)
+        self._n = n
+
+    def __len__(self):
+        return self._n
+
+    def sample(self, i, rng=None):
+        s = self._ds.sample(i)
+        s["extra_info"] = ("seq0", i)
+        return s
+
+
+def test_warmstart_validator_identical_epe_device_vs_host_splat(tiny_model):
+    """The Sintel warm-start validator path
+    (evaluation._run_warmstart_metric_pass, all-device splat) produces
+    IDENTICAL metrics to a host-splat reference loop over the same
+    frames — swapping the splat implementation changes nothing, because
+    the splats themselves are bitwise equal."""
+    from raft_ncup_tpu.evaluation import (
+        _pad_host,
+        _run_warmstart_metric_pass,
+    )
+    from raft_ncup_tpu.inference import metrics as metrics_mod
+    from raft_ncup_tpu.inference.pipeline import ShapeCachedForward
+    from raft_ncup_tpu.ops import InputPadder
+
+    model, variables = tiny_model
+    ds = _SeqDataset(4)
+
+    fwd = ShapeCachedForward(model, variables)
+    acc_dev = _run_warmstart_metric_pass(fwd, ds, kind="px", iters=2)
+    m_dev = metrics_mod.finalize("px", acc_dev)
+
+    # Host-splat reference: same frames, same executable, but the warm
+    # chain goes through the cKDTree splat with a per-frame pull.
+    fwd_ref = ShapeCachedForward(model, variables)
+    acc = metrics_mod.init_acc("px")
+    flow_prev = None
+    for i in range(len(ds)):
+        s = ds.sample(i)
+        img1 = np.asarray(s["image1"], np.float32)[None]
+        img2 = np.asarray(s["image2"], np.float32)[None]
+        gt = np.asarray(s["flow"], np.float32)[None]
+        padder = InputPadder(img1.shape, mode="sintel")
+        pad = padder.pad_spec
+        img1, img2 = _pad_host(pad, img1, img2)
+        if flow_prev is None:
+            h8, w8 = img1.shape[1] // 8, img1.shape[2] // 8
+            flow_prev = jnp.zeros((1, h8, w8, 2), jnp.float32)
+        acc, flow_lr = fwd_ref.metrics(
+            {"image1": img1, "image2": img2, "flow": gt},
+            iters=2, acc=acc, kind="px", pad=pad, flow_init=flow_prev,
+        )
+        flow_prev = jnp.asarray(
+            forward_interpolate(np.asarray(jax.device_get(flow_lr))[0])[None]
+        )
+    m_host = metrics_mod.finalize(
+        "px", np.asarray(jax.device_get(acc), np.float64)
+    )
+    assert m_dev == m_host
+
+    # And warm start genuinely changed the chain vs cold evaluation:
+    fwd_cold = ShapeCachedForward(model, variables)
+    acc_cold = metrics_mod.init_acc("px")
+    for i in range(len(ds)):
+        s = ds.sample(i)
+        img1 = np.asarray(s["image1"], np.float32)[None]
+        img2 = np.asarray(s["image2"], np.float32)[None]
+        gt = np.asarray(s["flow"], np.float32)[None]
+        padder = InputPadder(img1.shape, mode="sintel")
+        img1, img2 = _pad_host(padder.pad_spec, img1, img2)
+        acc_cold = fwd_cold.metrics(
+            {"image1": img1, "image2": img2, "flow": gt},
+            iters=2, acc=acc_cold, kind="px", pad=padder.pad_spec,
+        )
+    m_cold = metrics_mod.finalize(
+        "px", np.asarray(jax.device_get(acc_cold), np.float64)
+    )
+    assert m_dev["epe"] != m_cold["epe"]
+
+
+def test_warmstart_pass_is_pull_free(tiny_model):
+    """The device-splat pass performs ONE sanctioned pull (the window
+    accumulator) and zero implicit transfers — the deleted JGL008
+    allowlist entry stays deleted."""
+    from raft_ncup_tpu.analysis.guards import forbid_host_transfers
+    from raft_ncup_tpu.evaluation import _run_warmstart_metric_pass
+    from raft_ncup_tpu.inference.pipeline import ShapeCachedForward
+
+    model, variables = tiny_model
+    ds = _SeqDataset(3)
+    fwd = ShapeCachedForward(model, variables)
+    # Warm the executables outside the guard (compiles pull constants).
+    _run_warmstart_metric_pass(fwd, ds, kind="epe", iters=1)
+    with forbid_host_transfers() as stats:
+        _run_warmstart_metric_pass(fwd, ds, kind="epe", iters=1)
+    assert stats.host_transfers == 0
+    assert stats.sanctioned_gets == 1
