@@ -123,9 +123,14 @@ def _child_main() -> None:
         # row (VERDICT r4 #6): one uncontended full-shape CPU measurement
         # that makes a future TPU number immediately interpretable.
         shape = SMALL
-    # bf16 on any accelerator platform ('tpu' via the standard plugin, but
-    # the axon tunnel reports its own platform string — VERDICT.md weak #6).
-    mixed_precision = platform != "cpu"
+    # The precision policy owns dtype now (docs/PRECISION.md): the primary
+    # rows measure the f32 preset on EVERY platform and the `*_bf16` rows
+    # carry bf16 — pre-policy this flag was platform != "cpu", which would
+    # make the bf16 parity reference itself bf16 on an accelerator and
+    # leave the flip gate comparing bf16 against bf16. No TPU baselines
+    # were ever pinned (the tunnel has been wedged throughout), so the
+    # primary-row semantics change invalidates nothing recorded.
+    mixed_precision = False
 
     if nconv_impl == "pallas":
         # Tally trace-time dispatch so the record can say whether the
@@ -387,9 +392,172 @@ def _child_main() -> None:
         except Exception as e:  # never lose the earlier rows
             print(f"stream bench failed: {e}", file=sys.stderr)
 
+    # bf16 rows (docs/PRECISION.md; ROADMAP item 3): the same guarded
+    # forward / train-loop / val / serve / stream measurements re-run
+    # under the precision policy's bf16 presets, every key suffixed
+    # `_bf16`. The forward row additionally records the parity field
+    # (`bf16_forward_epe_vs_f32`, vs the f32 executable on the same
+    # inputs) and the test-pinned budget, so flip_recommendations can
+    # gate a default flip on MEASURED parity + clean guard counters —
+    # the corr_impl discipline applied to precision. The same f32
+    # variables serve both (f32 master weights; modules cast).
+    # BENCH_SKIP_BF16=1 turns the whole block off explicitly. On CPU
+    # bf16 is emulated (slower, parity still meaningful); the rows are
+    # first in line for real numbers when a chip answers.
+    if os.environ.get("BENCH_SKIP_BF16") == "1":
+        pass
+    elif child_budget - (time.monotonic() - t0) > 0.3 * child_budget:
+        try:
+            record.update(_measure_bf16_forward(
+                shape, corr_impl, forward, variables, img1, img2
+            ))
+            _emit(record)
+        except Exception as e:  # never lose the earlier rows
+            print(f"bf16 forward bench failed: {e}", file=sys.stderr)
+        def _measure_val_bf16(shape, mixed_precision, corr_impl, variables,
+                              precision):
+            # The bf16 val row must run under the SAME thread
+            # configuration as its f32 sibling or the CPU comparison
+            # embeds the known all-cores contention artifact (the reason
+            # _run_val_child exists): sub-child with one core reserved
+            # on CPU, in-process elsewhere.
+            if platform == "cpu":
+                spare = child_budget - (time.monotonic() - t0) - 10.0
+                out = _run_val_child(
+                    shape, corr_impl, min(300.0, spare),
+                    precision=precision,
+                )
+                if out is not None:
+                    return out
+                print(
+                    "bf16 val sub-child yielded nothing; measuring "
+                    "in-process (shared XLA pool — expect contention)",
+                    file=sys.stderr,
+                )
+            return _measure_val_loop(
+                shape, mixed_precision, corr_impl, variables,
+                precision=precision,
+            )
+
+        for tag, skip_env, fn in (
+            ("val", "BENCH_SKIP_VAL", _measure_val_bf16),
+            ("serve", "BENCH_SKIP_SERVE", _measure_serve),
+            ("stream", "BENCH_SKIP_STREAM", _measure_stream),
+        ):
+            if os.environ.get(skip_env) == "1":
+                continue
+            if child_budget - (time.monotonic() - t0) < 0.1 * child_budget:
+                break
+            try:
+                rows = fn(shape, mixed_precision, corr_impl, variables,
+                          precision="bf16_infer")
+                record.update({f"{k}_bf16": v for k, v in rows.items()})
+                _emit(record)
+            except Exception as e:  # never lose the earlier rows
+                print(f"bf16 {tag} bench failed: {e}", file=sys.stderr)
+        # bf16_train loop last: it pays a second fwd+bwd compile, the
+        # most expensive item in the block.
+        if (
+            os.environ.get("BENCH_SKIP_TRAIN") != "1"
+            and child_budget - (time.monotonic() - t0) > 0.25 * child_budget
+        ):
+            try:
+                fields, handles = _measure_train_step(
+                    shape, mixed_precision, corr_impl,
+                    precision="bf16_train",
+                )
+                record.update(
+                    {f"{k}_bf16": v for k, v in fields.items()}
+                )
+                # Emit the step row before attempting the loop: the
+                # fwd+bwd compile it paid for must survive a loop
+                # failure or a watchdog kill mid-loop.
+                _emit(record)
+                if (
+                    child_budget - (time.monotonic() - t0)
+                    > 0.1 * child_budget
+                ):
+                    loop = _measure_train_loop(handles)
+                    record.update(
+                        {f"{k}_bf16": v for k, v in loop.items()}
+                    )
+                    _emit(record)
+            except Exception as e:  # never lose the earlier rows
+                print(f"bf16 train bench failed: {e}", file=sys.stderr)
+
+
+def _measure_bf16_forward(
+    shape: dict, corr_impl: str, f32_forward, variables: dict,
+    img1, img2,
+) -> dict:
+    """The bf16_infer test-mode forward at the bench shape: throughput
+    (`pairs_per_sec_bf16`), guard counters over the timed reps
+    (`fwd_bf16_recompiles` / `fwd_bf16_host_transfers` — 0 in steady
+    state, same machinery as the f32 rows), and the parity field
+    (`bf16_forward_epe_vs_f32`: mean EPE between the bf16 and f32
+    predictions on the SAME inputs/variables) next to the test-pinned
+    budget, so flip_recommendations can judge the row without importing
+    jax."""
+    import jax
+    import numpy as np
+
+    from raft_ncup_tpu.analysis.guards import (
+        GuardStats,
+        RecompileWatchdog,
+        forbid_host_transfers,
+    )
+    from raft_ncup_tpu.config import flagship_config
+    from raft_ncup_tpu.models.raft import get_model
+    from raft_ncup_tpu.precision import FORWARD_EPE_BUDGET
+    from raft_ncup_tpu.utils.profiling import measure_throughput_detailed
+
+    strict = os.environ.get("BENCH_STRICT_GUARDS") == "1"
+    iters = shape["iters"]
+    model = get_model(
+        flagship_config(
+            dataset="sintel", corr_impl=corr_impl, precision="bf16_infer"
+        )
+    )
+
+    def fwd(v, a, b):
+        return model.apply(v, a, b, iters=iters, test_mode=True)
+
+    bf16_forward = jax.jit(fwd)
+    # Parity on the warm executables (one extra f32 call, both warm
+    # before the timed window).
+    ref = np.asarray(jax.device_get(f32_forward(variables, img1, img2)[1]))
+    out = np.asarray(
+        jax.device_get(bf16_forward(variables, img1, img2)[1])
+    )
+    epe = float(np.sqrt(((out - ref) ** 2).sum(-1)).mean())
+    # Pre-warm the sync path's tiny scalar-index program OUTSIDE the
+    # guarded window (its first use would otherwise count as a
+    # steady-state compile).
+    jax.device_get(bf16_forward(variables, img1, img2)[1][0, 0, 0, 0])
+
+    stats = GuardStats()
+    with RecompileWatchdog() as wd, forbid_host_transfers(
+        stats, raise_on_violation=strict
+    ):
+        rate, rep_times = measure_throughput_detailed(
+            lambda: bf16_forward(variables, img1, img2),
+            warmup=1,
+            reps=3,
+            sync=lambda o: np.asarray(jax.device_get(o[1][0, 0, 0, 0])),
+        )
+    return {
+        "pairs_per_sec_bf16": round(shape["batch"] * rate, 4),
+        "bf16_rep_ms": [round(t * 1e3, 1) for t in rep_times],
+        "bf16_forward_epe_vs_f32": round(epe, 5),
+        "bf16_epe_budget": FORWARD_EPE_BUDGET,
+        "fwd_bf16_recompiles": wd.count,
+        "fwd_bf16_host_transfers": stats.host_transfers,
+    }
+
 
 def _measure_train_step(
-    shape: dict, mixed_precision: bool, corr_impl: str
+    shape: dict, mixed_precision: bool, corr_impl: str,
+    precision: str = "f32",
 ) -> tuple[dict, dict]:
     """Time one optimizer step (fwd+bwd+update) at the bench shape,
     reference workload anchor: train.py:201-225.
@@ -407,11 +575,12 @@ def _measure_train_step(
 
     B, H, W = shape["batch"], shape["height"], shape["width"]
     model_cfg = flagship_config(
-        dataset="sintel", mixed_precision=mixed_precision, corr_impl=corr_impl
+        dataset="sintel", mixed_precision=mixed_precision,
+        corr_impl=corr_impl, precision=precision,
     )
     train_cfg = TrainConfig(
         stage="sintel", batch_size=B, image_size=(H, W),
-        iters=shape["iters"], num_steps=100,
+        iters=shape["iters"], num_steps=100, precision=precision,
     )
     model, state = create_train_state(
         jax.random.PRNGKey(0), model_cfg, train_cfg,
@@ -535,7 +704,7 @@ def _measure_train_loop(handles: dict, steps: int | None = None) -> dict:
 
 def _measure_val_loop(
     shape: dict, mixed_precision: bool, corr_impl: str, variables: dict,
-    n_batches: int | None = None,
+    n_batches: int | None = None, precision: str = "f32",
 ) -> dict:
     """Wall-clock the PIPELINED eval loop vs the per-batch-synced one —
     the steady-state validation path (docs/PERF.md "Eval pipeline").
@@ -612,7 +781,7 @@ def _measure_val_loop(
     model = get_model(
         flagship_config(
             dataset="sintel", mixed_precision=mixed_precision,
-            corr_impl=corr_impl,
+            corr_impl=corr_impl, precision=precision,
         )
     )
     fwd = ShapeCachedForward(model, variables)
@@ -725,7 +894,7 @@ def _measure_val_loop(
 
 def _measure_serve(
     shape: dict, mixed_precision: bool, corr_impl: str, variables: dict,
-    n_requests: int | None = None,
+    n_requests: int | None = None, precision: str = "f32",
 ) -> dict:
     """Steady-state serving latency/throughput through the FlowServer
     front-end (serving/server.py; docs/SERVING.md).
@@ -780,6 +949,7 @@ def _measure_serve(
         batch_sizes=(1, 2),
         iter_levels=levels,
         recover_patience=2,
+        precision=precision,
     )
     model = get_model(
         flagship_config(
@@ -841,7 +1011,7 @@ def _measure_serve(
 
 def _measure_stream(
     shape: dict, mixed_precision: bool, corr_impl: str, variables: dict,
-    n_frames: int | None = None,
+    n_frames: int | None = None, precision: str = "f32",
 ) -> dict:
     """Steady-state multi-stream video throughput through the
     StreamEngine (streaming/engine.py; docs/STREAMING.md).
@@ -894,6 +1064,7 @@ def _measure_stream(
         iters=iters,
         batch_sizes=(1, 2, 4),
         queue_capacity=max(8, n_streams * frames),
+        precision=precision,
     )
     model = get_model(
         flagship_config(
@@ -1031,6 +1202,7 @@ def _val_child_main() -> None:
 
     shape = json.loads(os.environ["_BENCH_SHAPE"])
     corr_impl = os.environ.get("BENCH_CORR_IMPL", "volume")
+    precision = os.environ.get("_BENCH_PRECISION", "f32")
     model = get_model(
         flagship_config(
             dataset="sintel", mixed_precision=False, corr_impl=corr_impl
@@ -1039,13 +1211,22 @@ def _val_child_main() -> None:
     variables = model.init(
         jax.random.PRNGKey(0), (1, shape["height"], shape["width"], 3)
     )
-    _emit(_measure_val_loop(shape, False, corr_impl, variables))
+    _emit(
+        _measure_val_loop(
+            shape, False, corr_impl, variables, precision=precision
+        )
+    )
 
 
-def _run_val_child(shape: dict, corr_impl: str, timeout_s: float):
+def _run_val_child(
+    shape: dict, corr_impl: str, timeout_s: float, precision: str = "f32"
+):
     """Run the val row in a sub-child with the serving thread config
     (one host core reserved for the input pipeline). Returns the val_*
-    fields dict, or None on failure/timeout."""
+    fields dict, or None on failure/timeout. ``precision`` selects the
+    policy preset the child measures under (the bf16 val row uses the
+    SAME sub-child configuration as the f32 one, so the two rows differ
+    only by policy)."""
     if timeout_s < 45:
         return None
     from raft_ncup_tpu.utils.backend_probe import run_watchdogged
@@ -1056,6 +1237,7 @@ def _run_val_child(shape: dict, corr_impl: str, timeout_s: float):
     env["JAX_PLATFORMS"] = "cpu"
     env["_BENCH_SHAPE"] = json.dumps(shape)
     env["BENCH_CORR_IMPL"] = corr_impl
+    env["_BENCH_PRECISION"] = precision
     env["XLA_FLAGS"] = (
         env.get("XLA_FLAGS", "") + " --xla_cpu_multi_thread_eigen=false"
     ).strip()

@@ -18,6 +18,7 @@ from raft_ncup_tpu.analysis.rules import (
     jgl006_partition_axes,
     jgl007_swallowed_exceptions,
     jgl008_eval_loop_pulls,
+    jgl009_precision_policy,
 )
 
 ALL_RULES = (
@@ -29,6 +30,7 @@ ALL_RULES = (
     jgl006_partition_axes,
     jgl007_swallowed_exceptions,
     jgl008_eval_loop_pulls,
+    jgl009_precision_policy,
 )
 
 RULES_BY_ID = {mod.RULE_ID: mod for mod in ALL_RULES}

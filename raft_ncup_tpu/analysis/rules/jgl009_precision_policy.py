@@ -1,0 +1,131 @@
+"""JGL009 — raw dtype literals bypassing the precision policy.
+
+The precision-policy subsystem (``raft_ncup_tpu/precision/``;
+docs/PRECISION.md) is the single authority for every dtype on the hot
+path: module compute, correlation volume, coordinate carry, outputs.
+A raw inline ``jnp.float32`` / ``jnp.bfloat16`` / ``jnp.float16`` in a
+hot-path function body is a dtype decision the policy cannot see — it
+either silently pins a tensor wide (the bf16 presets stop paying off
+exactly where the literal sits) or, worse, silently narrows something
+the policy pins f32 (coordinates, accumulators).
+
+Scope: ``models/``, ``nn/``, ``inference/`` — the forward hot path —
+plus ``resilience/anomaly.py`` (the divergence sentinel's arithmetic
+must stay f32 *by policy*, so its literals are allowlisted with
+justification rather than invisible).
+
+Sanctioned routings (NOT flagged):
+
+- reading a policy: ``self.policy.compute_jnp``, ``policy.coord_jnp`` —
+  no literal appears;
+- a class-body attribute default (``dtype: Any = jnp.float32`` — the
+  flax idiom: the attribute *is* the policy-settable knob, and callers
+  override it from the policy);
+- a module-level named constant (``PARAM_DTYPE = jnp.float32`` with a
+  comment saying which pinned policy dtype it mirrors — e.g.
+  ``nn/layers.py``'s master-weight/norm constants, which the policy
+  constructor's f32 pins make authoritative).
+
+Everything else — an ``astype(jnp.float32)`` inside a forward, a
+``jnp.zeros(..., jnp.bfloat16)`` in a pipeline stage — is a finding;
+deliberate exceptions (the f32 metric accumulators in
+``inference/metrics.py``, the sentinel arithmetic) carry
+justification-mandatory allowlist entries.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from raft_ncup_tpu.analysis.astutil import (
+    Finding,
+    ModuleContext,
+    dotted_name,
+    qualname,
+)
+
+RULE_ID = "JGL009"
+SUMMARY = (
+    "raw jnp.float32/bfloat16/float16 literal bypassing the precision "
+    "policy in models/, nn/, inference/ (and the sentinel)"
+)
+
+_DTYPE_NAMES = frozenset(
+    {
+        "jax.numpy.float32",
+        "jax.numpy.bfloat16",
+        "jax.numpy.float16",
+    }
+)
+
+
+def _in_scope(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return (
+        "/models/" in p
+        or p.startswith("models/")
+        or "/nn/" in p
+        or p.startswith("nn/")
+        or "/inference/" in p
+        or p.startswith("inference/")
+        or p.endswith("resilience/anomaly.py")
+    )
+
+
+def _exempt_nodes(tree: ast.AST) -> set:
+    """ids of nodes inside sanctioned literal positions: the VALUE of an
+    assignment sitting directly in a module or class body (named
+    constants and flax attribute defaults)."""
+    exempt: set = set()
+    scopes = [tree] + [
+        n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)
+    ]
+    for scope in scopes:
+        for stmt in scope.body:
+            value = None
+            if isinstance(stmt, ast.Assign):
+                value = stmt.value
+            elif isinstance(stmt, ast.AnnAssign):
+                value = stmt.value
+            if value is None:
+                continue
+            for sub in ast.walk(value):
+                exempt.add(id(sub))
+    return exempt
+
+
+def check(ctx: ModuleContext) -> Iterator[Finding]:
+    if not _in_scope(ctx.path):
+        return
+    exempt = _exempt_nodes(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.Name, ast.Attribute)):
+            continue
+        if id(node) in exempt:
+            continue
+        # An Attribute chain is visited once per link; only report the
+        # full chain (whose parent is not itself part of the match).
+        dn = dotted_name(node, ctx.aliases)
+        if dn not in _DTYPE_NAMES:
+            continue
+        from raft_ncup_tpu.analysis.astutil import parent
+
+        p = parent(node)
+        if isinstance(p, ast.Attribute) and dotted_name(
+            p, ctx.aliases
+        ) in _DTYPE_NAMES:
+            continue  # inner link of the same dotted chain
+        yield Finding(
+            ctx.path,
+            node.lineno,
+            node.col_offset,
+            RULE_ID,
+            f"raw `jnp.{dn.split('.')[-1]}` literal on the hot path: dtype "
+            "decisions route through the PrecisionPolicy "
+            "(raft_ncup_tpu/precision/) — use policy.compute_jnp/"
+            "coord_jnp/..., a policy-settable module attribute, or a "
+            "named module-level constant documenting which pinned "
+            "policy dtype it mirrors",
+            qualname(node),
+        )

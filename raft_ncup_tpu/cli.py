@@ -63,6 +63,14 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--small", action="store_true")
     parser.add_argument("--dropout", type=float, default=0.0)
     parser.add_argument("--mixed_precision", action="store_true")
+    parser.add_argument("--precision", default=None,
+                        choices=["f32", "bf16_infer", "bf16_train"],
+                        help="precision-policy preset (docs/PRECISION.md): "
+                        "the single dtype authority for the hot path. "
+                        "'bf16_infer' for eval/serving, 'bf16_train' for "
+                        "bf16-compute training with f32 master weights; "
+                        "coords/metrics/upsampler stay f32 under every "
+                        "preset. Overrides --mixed_precision when set.")
     parser.add_argument("--align_corners", action="store_true")
     parser.add_argument("--upsampler_bi", action="store_true",
                         help="use bilinear final upsampling")
@@ -204,6 +212,12 @@ def add_serve_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--serve_cache_size", type=int, default=d.cache_size,
                         help="compiled-executable LRU bound; keep >= "
                         "shapes x batch_sizes x iter_levels")
+    parser.add_argument("--serve_precision", default=d.precision,
+                        choices=["f32", "bf16_infer", "bf16_train"],
+                        help="precision-policy preset the server's whole "
+                        "executable set compiles under "
+                        "(docs/PRECISION.md); part of every compiled-"
+                        "program key. Default: inherit the model's policy")
 
 
 def serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
@@ -217,6 +231,7 @@ def serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
         default_deadline_s=args.deadline_s,
         pad_bucket=args.serve_pad_bucket,
         cache_size=args.serve_cache_size,
+        precision=args.serve_precision,
     )
 
 
@@ -258,6 +273,12 @@ def add_stream_args(parser: argparse.ArgumentParser) -> None:
                         default=d.pad_bucket,
                         help="round padded frame shapes up to multiples "
                         "of this bucket (0=off)")
+    parser.add_argument("--stream_precision", default=d.precision,
+                        choices=["f32", "bf16_infer", "bf16_train"],
+                        help="precision-policy preset for the engine's "
+                        "step programs AND the slot-table state dtype "
+                        "(bf16 halves per-stream HBM; docs/PRECISION.md). "
+                        "Default: inherit the model's policy")
 
 
 def stream_config_from_args(
@@ -274,6 +295,7 @@ def stream_config_from_args(
         idle_timeout_s=args.idle_timeout_s,
         carry_net=args.carry_net,
         anomaly_max_flow=args.anomaly_max_flow,
+        precision=args.stream_precision,
     )
 
 
@@ -381,7 +403,14 @@ def model_config_from_args(
         variant=args.model,
         small=args.small,
         dropout=args.dropout,
-        mixed_precision=args.mixed_precision,
+        # An explicit --precision (any preset, 'f32' included) wins over
+        # the legacy --mixed_precision bool; only the unset default lets
+        # the bool map to bf16_infer.
+        precision=getattr(args, "precision", None) or "f32",
+        mixed_precision=(
+            args.mixed_precision
+            and getattr(args, "precision", None) is None
+        ),
         align_corners=args.align_corners,
         corr_impl=args.corr_impl,
         dataset=dataset,
@@ -422,6 +451,7 @@ def train_config_from_args(args: argparse.Namespace) -> TrainConfig:
         sentinel_ema_decay=args.sentinel_ema_decay,
         sentinel_warmup=args.sentinel_warmup,
         sentinel_halt_after=args.sentinel_halt_after,
+        precision=getattr(args, "precision", None) or "f32",
     )
 
 

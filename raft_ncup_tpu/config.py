@@ -77,11 +77,20 @@ class ModelConfig:
     variant: str = "raft_nc_dbl"  # 'raft' | 'raft_nc_dbl'
     small: bool = False
     dropout: float = 0.0
-    # bfloat16 activations in encoders + update block (TPU analogue of the
-    # reference's CUDA AMP fp16 autocast, reference: core/raft.py:100-112).
-    # The correlation volume and the NCUP upsampler stay float32, as in the
-    # reference (fmaps cast .float() at core/raft.py:103-104; the upsampler
-    # call sits outside autocast at core/raft_nc_dbl.py:161).
+    # Precision-policy preset (raft_ncup_tpu/precision/; docs/PRECISION.md):
+    # 'f32' | 'bf16_infer' | 'bf16_train'. The resolved PrecisionPolicy is
+    # the single authority for every dtype on the hot path — module compute,
+    # correlation volume, Pallas VMEM budgeting — with coords/metrics/
+    # upsampler/master-weights pinned f32 by the policy itself.
+    precision: str = "f32"
+    # Legacy bool knob, kept for the reference CLI surface
+    # (--mixed_precision): True with the default precision resolves to
+    # the 'bf16_infer' preset. DELIBERATE divergence from the reference's
+    # CUDA AMP autocast (core/raft.py:100-112): under the policy the
+    # correlation volume now narrows too — it is the dominant memory
+    # term, and parity is test-pinned rather than assumed
+    # (docs/PRECISION.md; CHANGES.md PR 7). An explicit `precision` wins
+    # (the CLI sets mixed_precision=False whenever --precision is given).
     mixed_precision: bool = False
     # align_corners for the bilinear x8 upsampling used by the small/no-mask
     # path (reference: core/raft.py:134; fixes the upflow8 signature bug
@@ -106,6 +115,20 @@ class ModelConfig:
     def __post_init__(self) -> None:
         if self.variant not in ("raft", "raft_nc_dbl"):
             raise ValueError(f"unknown model variant: {self.variant!r}")
+        from raft_ncup_tpu.precision import resolve_policy
+
+        resolve_policy(self.precision)  # raises on an unknown preset
+
+    @property
+    def precision_policy(self):
+        """The resolved :class:`~raft_ncup_tpu.precision.PrecisionPolicy`
+        (the legacy ``mixed_precision`` bool maps onto 'bf16_infer' when
+        no explicit preset was chosen)."""
+        from raft_ncup_tpu.precision import resolve_policy
+
+        if self.precision == "f32" and self.mixed_precision:
+            return resolve_policy("bf16_infer")
+        return resolve_policy(self.precision)
 
     @property
     def hidden_dim(self) -> int:
@@ -173,6 +196,18 @@ class TrainConfig:
     sentinel_ema_decay: float = 0.99
     sentinel_warmup: int = 10  # good steps before spike detection arms
     sentinel_halt_after: int = 10  # K consecutive bad steps => halt
+    # Training precision preset (docs/PRECISION.md): 'f32' or 'bf16_train'
+    # (bf16 module compute with f32 master weights; loss/grad-norm/
+    # sentinel arithmetic stays f32 because the param leaves do). The CLI
+    # threads this into ModelConfig.precision so the step program and the
+    # policy agree; bookkept here so checkpoints' resume metadata and the
+    # bench's train rows can say which phase opted in.
+    precision: str = "f32"
+
+    def __post_init__(self) -> None:
+        from raft_ncup_tpu.precision import resolve_policy
+
+        resolve_policy(self.precision)  # raises on an unknown preset
 
     @property
     def total_schedule_steps(self) -> int:
@@ -274,8 +309,19 @@ class ServeConfig:
     # pyramid; larger than max is rejected rather than compiled.
     min_image_hw: int = 16
     max_image_hw: tuple[int, int] = (1088, 1920)
+    # Per-ServeConfig precision policy (docs/PRECISION.md): the server's
+    # whole executable set compiles under this preset, and the policy
+    # name is part of every compiled-program key, so two servers (or one
+    # redeployed with a different preset) can never collide executables.
+    # None (default) inherits the model's own policy — a server wrapped
+    # around a bf16-configured model serves bf16 unless told otherwise.
+    precision: str | None = None
 
     def __post_init__(self) -> None:
+        if self.precision is not None:
+            from raft_ncup_tpu.precision import resolve_policy
+
+            resolve_policy(self.precision)  # raises on an unknown preset
         bs = tuple(int(b) for b in self.batch_sizes)
         if not bs or any(b <= 0 for b in bs) or list(bs) != sorted(set(bs)):
             raise ValueError(
@@ -354,8 +400,19 @@ class StreamConfig:
     # (ops/warmstart.forward_interpolate_jax): bounds the transient
     # distance matrix at chunk * (h/8 * w/8) * 4 bytes per stream row.
     splat_chunk: int = 1024
+    # Per-engine precision policy (docs/PRECISION.md). Under the bf16
+    # presets the slot table's recurrent state (prev low-res flow,
+    # optional GRU net) is STORED in bf16 — halving per-stream HBM —
+    # while the warm-start splat and coordinate arithmetic upcast to the
+    # policy's pinned f32 coord dtype in-graph. None (default) inherits
+    # the model's own policy.
+    precision: str | None = None
 
     def __post_init__(self) -> None:
+        if self.precision is not None:
+            from raft_ncup_tpu.precision import resolve_policy
+
+            resolve_policy(self.precision)  # raises on an unknown preset
         bs = tuple(int(b) for b in self.batch_sizes)
         if not bs or any(b <= 0 for b in bs) or list(bs) != sorted(set(bs)):
             raise ValueError(

@@ -319,6 +319,7 @@ def _run_warmstart_metric_pass(
 def validate_chairs(
     model: RAFT, variables: dict, data_cfg: Optional[DataConfig] = None,
     iters: int = 24, batch_size: int = 4, mesh=None,
+    precision: Optional[str] = None,
 ) -> dict:
     """FlyingChairs validation-split EPE (reference: evaluate.py:90-108)."""
     cfg = data_cfg or DataConfig()
@@ -331,7 +332,8 @@ def validate_chairs(
         _print_main(f"validate_chairs: no data under {cfg.root_chairs}, skipping")
         return {}
     fwd = ShapeCachedForward(
-        model, variables, mesh=mesh, cache_size=cfg.eval_cache_size
+        model, variables, mesh=mesh, cache_size=cfg.eval_cache_size,
+        policy=precision,
     )
     acc = _run_metric_pass(
         fwd, dataset, kind="epe", iters=iters, batch_size=batch_size,
@@ -347,7 +349,7 @@ def validate_chairs(
 def validate_sintel(
     model: RAFT, variables: dict, data_cfg: Optional[DataConfig] = None,
     iters: int = 32, batch_size: int = 2, mesh=None,
-    warm_start: bool = False,
+    warm_start: bool = False, precision: Optional[str] = None,
 ) -> dict:
     """Sintel train-split clean+final EPE / 1px / 3px / 5px
     (reference: evaluate.py:111-143).
@@ -366,7 +368,8 @@ def validate_sintel(
             "single host, no mesh (see _run_warmstart_metric_pass)"
         )
     fwd = ShapeCachedForward(
-        model, variables, mesh=mesh, cache_size=cfg.eval_cache_size
+        model, variables, mesh=mesh, cache_size=cfg.eval_cache_size,
+        policy=precision,
     )
     results = {}
     prefix = "warm_" if warm_start else ""
@@ -429,6 +432,7 @@ def validate_sintel_warm(
 def validate_kitti(
     model: RAFT, variables: dict, data_cfg: Optional[DataConfig] = None,
     iters: int = 24, batch_size: int = 2, mesh=None,
+    precision: Optional[str] = None,
 ) -> dict:
     """KITTI-2015 train-split EPE + F1 (reference: evaluate.py:146-182).
     F1 = % of valid pixels with epe > 3 and epe/mag > 0.05.
@@ -445,7 +449,8 @@ def validate_kitti(
         _print_main(f"validate_kitti: no data under {cfg.root_kitti}, skipping")
         return {}
     fwd = ShapeCachedForward(
-        model, variables, mesh=mesh, cache_size=cfg.eval_cache_size
+        model, variables, mesh=mesh, cache_size=cfg.eval_cache_size,
+        policy=precision,
     )
     acc = _run_metric_pass(
         fwd, dataset, kind="kitti", iters=iters, batch_size=batch_size,
@@ -469,6 +474,7 @@ def create_sintel_submission(
     output_path: str = "sintel_submission",
     write_png: bool = False,
     mesh=None,
+    precision: Optional[str] = None,
 ) -> None:
     """Write Sintel leaderboard .flo files (reference: evaluate.py:22-57),
     optionally warm-starting each sequence from the previous frame's
@@ -496,7 +502,8 @@ def create_sintel_submission(
         return
     cfg = data_cfg or DataConfig()
     fwd = ShapeCachedForward(
-        model, variables, mesh=mesh, cache_size=cfg.eval_cache_size
+        model, variables, mesh=mesh, cache_size=cfg.eval_cache_size,
+        policy=precision,
     )
     for dstype in ("clean", "final"):
         dataset = ds_mod.MpiSintel(
@@ -569,6 +576,7 @@ def create_kitti_submission(
     output_path: str = "kitti_submission",
     write_png: bool = False,
     mesh=None,
+    precision: Optional[str] = None,
 ) -> None:
     """Write KITTI leaderboard 16-bit pngs (reference: evaluate.py:60-87).
     All processes compute when a global mesh forces lockstep, only main
@@ -580,7 +588,8 @@ def create_kitti_submission(
     cfg = data_cfg or DataConfig()
     dataset = ds_mod.KITTI(None, split="testing", root=cfg.root_kitti)
     fwd = ShapeCachedForward(
-        model, variables, mesh=mesh, cache_size=cfg.eval_cache_size
+        model, variables, mesh=mesh, cache_size=cfg.eval_cache_size,
+        policy=precision,
     )
     if write:
         os.makedirs(output_path, exist_ok=True)
@@ -629,7 +638,7 @@ def validate_synthetic(
     model: RAFT, variables: dict, data_cfg: Optional[DataConfig] = None,
     iters: int = 12, batch_size: int = 4, size_hw: tuple[int, int] = (96, 128),
     length: int = 32, mesh=None, style: Optional[str] = None,
-    seed: int = 999,
+    seed: int = 999, precision: Optional[str] = None,
 ) -> dict:
     """EPE on a HELD-OUT procedural split (seed distinct from the
     training fallback's seed=0) so data-free runs (`--synthetic_ok`,
@@ -671,7 +680,8 @@ def validate_synthetic(
         return {}
     cfg = data_cfg or DataConfig()
     fwd = ShapeCachedForward(
-        model, variables, mesh=mesh, cache_size=cfg.eval_cache_size
+        model, variables, mesh=mesh, cache_size=cfg.eval_cache_size,
+        policy=precision,
     )
     kind = "epe_band" if style == "rigid" else "epe"
     acc = _run_metric_pass(

@@ -53,6 +53,7 @@ import jax.numpy as jnp
 
 from raft_ncup_tpu.data.device_prefetch import DevicePrefetcher
 from raft_ncup_tpu.inference import metrics as metrics_mod
+from raft_ncup_tpu.precision import resolve_policy
 
 
 class SamplePrefetcher:
@@ -332,7 +333,8 @@ class AsyncDrain:
 
 class ShapeCachedForward:
     """Bounded LRU of compiled test-mode executables, keyed by (padded
-    shape, iters, warm-start presence, metric kind/pad).
+    shape, iters, warm-start presence, metric kind/pad, precision-policy
+    fingerprint).
 
     Frames stream with dataset-dependent sizes, so each unique padded
     shape compiles once; the LRU bound (default 8, knob:
@@ -343,19 +345,60 @@ class ShapeCachedForward:
     ``InputPadder(bucket=...)``, to make the executable set small and
     known up front).
 
+    ``policy`` (a :mod:`raft_ncup_tpu.precision` preset name or
+    ``PrecisionPolicy``; default = the model's own) selects the dtype
+    policy every compiled program runs under; ``forward_device`` /
+    ``metrics`` accept a per-call override. The policy fingerprint is
+    part of EVERY cache key, so an f32 and a bf16 program for the same
+    shape can never collide — same variables (f32 master weights), two
+    executables (tests/test_inference_pipeline.py pins this).
+
     With ``mesh`` set (a (data, spatial) ``jax.sharding.Mesh``) every
     forward is one SPMD program: images sharded over (batch, height),
     variables/metrics replicated — the driver-level entry to
     spatially-sharded high-res eval (models/raft.py).
     """
 
-    def __init__(self, model, variables: dict, mesh=None, cache_size: int = 8):
+    def __init__(
+        self, model, variables: dict, mesh=None, cache_size: int = 8,
+        policy=None,
+    ):
         self.model = model
         self.variables = variables
         self.mesh = mesh
+        # apply()-compatible stand-ins (tests' dummy models) carry no
+        # policy; they resolve to the f32 default and are never swapped.
+        self.policy = (
+            resolve_policy(policy)
+            if policy is not None
+            else resolve_policy(getattr(model, "policy", None))
+        )
         self.cache_size = max(1, int(cache_size))
         self._fns: OrderedDict = OrderedDict()
+        self._models_by_policy: dict = {}
         self.stats = {"compiles": 0, "hits": 0, "evictions": 0}
+
+    def model_for(self, policy=None):
+        """Resolve (model, policy) for one call: the instance model when
+        the policy matches its config, else the same-architecture model
+        under the requested preset (same f32 master weights). Memoized
+        per instance so the serving/streaming dispatch path pays a dict
+        lookup, not a config rebuild, per batch."""
+        pol = resolve_policy(policy) if policy is not None else self.policy
+        own = getattr(self.model, "policy", None)
+        if own is None or pol.name == own.name:
+            return self.model, pol
+        model = self._models_by_policy.get(pol.name)
+        if model is None:
+            import dataclasses
+
+            from raft_ncup_tpu.models.raft import get_model
+
+            cfg = dataclasses.replace(
+                self.model.cfg, precision=pol.name, mixed_precision=False
+            )
+            model = self._models_by_policy[pol.name] = get_model(cfg)
+        return model, pol
 
     # ------------------------------------------------------------ internals
 
@@ -406,28 +449,36 @@ class ShapeCachedForward:
         and metric keys."""
         return self._get(("custom",) + tuple(key), build)
 
-    def forward_device(self, image1, image2, iters: int, flow_init=None):
+    def forward_device(
+        self, image1, image2, iters: int, flow_init=None, policy=None,
+    ):
         """Test-mode forward; returns DEVICE arrays (flow_lr, flow_up).
 
         The caller owns the pull: submissions hand the result to an
         :class:`AsyncDrain`, the legacy ``__call__`` wraps it in one
-        explicit ``jax.device_get``.
+        explicit ``jax.device_get``. ``policy`` overrides the instance
+        precision policy for this call; the fingerprint in the key keeps
+        the override's executable distinct.
         """
-        key = (tuple(image1.shape), iters, flow_init is not None)
+        model, pol = self.model_for(policy)
+        key = (
+            tuple(image1.shape), iters, flow_init is not None,
+            pol.fingerprint(),
+        )
 
         def build():
             mesh = self.mesh
             if flow_init is None:
 
                 def fn(v, i1, i2):
-                    return self.model.apply(
+                    return model.apply(
                         v, i1, i2, iters=iters, test_mode=True, mesh=mesh
                     )
 
             else:
 
                 def fn(v, i1, i2, finit):
-                    return self.model.apply(
+                    return model.apply(
                         v, i1, i2, iters=iters, flow_init=finit,
                         test_mode=True, mesh=mesh,
                     )
@@ -451,7 +502,7 @@ class ShapeCachedForward:
 
     def metrics(
         self, batch: dict, *, iters: int, acc, kind: str, pad=None,
-        flow_init=None,
+        flow_init=None, policy=None,
     ):
         """Forward + on-device metric fold in ONE jitted program.
 
@@ -478,6 +529,7 @@ class ShapeCachedForward:
             k: batch[k] for k in ("flow", "valid", "band") if k in batch
         }
         warm = flow_init is not None
+        model, pol = self.model_for(policy)
         key = (
             "metrics",
             tuple(batch["image1"].shape),
@@ -487,6 +539,7 @@ class ShapeCachedForward:
             kind,
             pad,
             warm,
+            pol.fingerprint(),
         )
 
         def build():
@@ -506,7 +559,7 @@ class ShapeCachedForward:
                             pad=pad,
                         )
 
-                    flow_lr, acc_out = self.model.apply(
+                    flow_lr, acc_out = model.apply(
                         v, i1, i2, iters=iters, flow_init=finit,
                         test_mode=True, mesh=mesh, metric_head=head,
                     )
@@ -526,7 +579,7 @@ class ShapeCachedForward:
                         pad=pad,
                     )
 
-                _, acc_out = self.model.apply(
+                _, acc_out = model.apply(
                     v, i1, i2, iters=iters, test_mode=True, mesh=mesh,
                     metric_head=head,
                 )

@@ -65,7 +65,12 @@ class RAFT:
 
     def __init__(self, cfg: ModelConfig):
         self.cfg = cfg
-        dtype = jnp.bfloat16 if cfg.mixed_precision else None
+        # The precision policy (raft_ncup_tpu/precision/; docs/PRECISION.md)
+        # is the single dtype authority: module compute dtype, correlation
+        # feature/volume dtype, and the pinned-f32 set (coords, upsampler,
+        # outputs, master weights) all come from here.
+        self.policy = cfg.precision_policy
+        dtype = self.policy.module_dtype
         hdim, cdim = cfg.hidden_dim, cfg.context_dim
 
         if cfg.small:
@@ -104,17 +109,20 @@ class RAFT:
         B, H, W, _ = image_shape
         h8, w8 = H // 8, W // 8
         cfg = self.cfg
+        # Template arrays for parameter init ride the policy's master-
+        # weight dtype (f32 in every preset).
+        pdt = self.policy.param_jnp
         hdim, cdim = cfg.hidden_dim, cfg.context_dim
         kf, kc, ku, kup = jax.random.split(rng, 4)
 
-        img = jnp.zeros((B, H, W, 3), jnp.float32)
+        img = jnp.zeros((B, H, W, 3), pdt)
         vf = self.fnet.init(kf, img)
         vc = self.cnet.init(kc, img)
 
-        net = jnp.zeros((B, h8, w8, hdim), jnp.float32)
-        inp = jnp.zeros((B, h8, w8, cdim), jnp.float32)
-        corr = jnp.zeros((B, h8, w8, cfg.corr_planes), jnp.float32)
-        flow = jnp.zeros((B, h8, w8, 2), jnp.float32)
+        net = jnp.zeros((B, h8, w8, hdim), pdt)
+        inp = jnp.zeros((B, h8, w8, cdim), pdt)
+        corr = jnp.zeros((B, h8, w8, cfg.corr_planes), pdt)
+        flow = jnp.zeros((B, h8, w8, 2), pdt)
         vu = self.update_block.init(ku, net, inp, corr, flow)
 
         params = {
@@ -128,8 +136,8 @@ class RAFT:
                 batch_stats[name] = v["batch_stats"]
 
         if self.upsampler is not None:
-            flow2 = jnp.zeros((B, h8 * 2, w8 * 2, 2), jnp.float32)
-            guidance = jnp.zeros((B, h8, w8, hdim), jnp.float32)
+            flow2 = jnp.zeros((B, h8 * 2, w8 * 2, 2), pdt)
+            guidance = jnp.zeros((B, h8, w8, hdim), pdt)
             vup = self.upsampler.init(kup, flow2, guidance)
             # Parameter-free heads (bilinear) init to an empty group so the
             # apply-side scoping stays uniform across upsampler kinds.
@@ -200,6 +208,7 @@ class RAFT:
         sharding actually reduce per-device memory.
         """
         cfg = self.cfg
+        policy = self.policy
         if image1.shape[1] % 8 or image1.shape[2] % 8:
             raise ValueError(
                 f"image H, W must be divisible by 8, got {image1.shape[1:3]}; "
@@ -242,12 +251,18 @@ class RAFT:
             bn_train=bn_train,
         )
         fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
-        fmap1 = fmap1.astype(jnp.float32)
-        fmap2 = fmap2.astype(jnp.float32)
+        # Correlation features/volume ride the policy's corr dtype — the
+        # dominant memory term, so the bf16 presets halve it (and double
+        # the Pallas VMEM dispatch thresholds). Coordinates stay at the
+        # policy's pinned f32; the lookups promote through them.
+        fmap1 = fmap1.astype(policy.corr_jnp)
+        fmap2 = fmap2.astype(policy.corr_jnp)
 
         radius = cfg.resolved_corr_radius
         if cfg.corr_impl == "volume":
-            pyramid = build_corr_pyramid(fmap1, fmap2, cfg.corr_levels)
+            pyramid = build_corr_pyramid(
+                fmap1, fmap2, cfg.corr_levels, dtype=policy.corr_jnp
+            )
 
             def corr_fn(coords):
                 return corr_lookup(pyramid, coords, radius)
@@ -274,7 +289,8 @@ class RAFT:
 
                     def local(f1_loc, f2_full, c_loc):
                         return corr_lookup_onthefly(
-                            f1_loc, f2_full, c_loc, radius, cfg.corr_levels
+                            f1_loc, f2_full, c_loc, radius, cfg.corr_levels,
+                            dtype=policy.corr_jnp,
                         )
 
                     return _shard_map(
@@ -292,7 +308,8 @@ class RAFT:
 
                 def corr_fn(coords):
                     return corr_lookup_onthefly(
-                        fmap1, fmap2, coords, radius, cfg.corr_levels
+                        fmap1, fmap2, coords, radius, cfg.corr_levels,
+                        dtype=policy.corr_jnp,
                     )
 
         elif cfg.corr_impl == "pallas":
@@ -316,7 +333,8 @@ class RAFT:
 
             def corr_fn(coords):
                 return corr_lookup_pallas(
-                    fmap1, fmap2, coords, radius, cfg.corr_levels, interpret
+                    fmap1, fmap2, coords, radius, cfg.corr_levels, interpret,
+                    policy.corr_jnp,
                 )
 
         else:
@@ -350,9 +368,12 @@ class RAFT:
             flow_lr = coords1 - coords0
             if cfg.variant == "raft_nc_dbl":
                 # nearest x2, NCUP x4, values x8 (reference:
-                # core/raft_nc_dbl.py:107-112,161).
+                # core/raft_nc_dbl.py:107-112,161). The upsampler runs at
+                # the policy's pinned f32 — outside the reference's
+                # autocast region, and NCUP's confidence arithmetic is
+                # ratio-of-sums (docs/PRECISION.md).
                 flow2 = upsample_nearest(flow_lr, 2)
-                guidance = net.astype(jnp.float32)
+                guidance = net.astype(policy.upsampler_jnp)
                 # The upsampler's only train-dependent piece is BatchNorm in
                 # the weights-estimation net, so it takes the bn flag.
                 hr = run(
@@ -361,7 +382,9 @@ class RAFT:
                 return 8.0 * hr
             if up_mask is None:
                 return upflow(flow_lr, 8, align_corners=cfg.align_corners)
-            return convex_upsample(flow_lr, up_mask.astype(jnp.float32), 8)
+            return convex_upsample(
+                flow_lr, up_mask.astype(policy.upsampler_jnp), 8
+            )
 
         # The raft (non-small) variant's convex upsampling needs the final
         # iteration's mask; in test mode the mask rides the scan carry so
@@ -386,7 +409,11 @@ class RAFT:
                 corr,
                 flow.astype(net.dtype),
             )
-            coords1 = coords1 + delta.astype(jnp.float32)
+            # The coordinate carry is the refinement's f32 backbone: the
+            # (possibly bf16) delta joins it at the policy's pinned
+            # coord dtype, so per-iteration compute error never narrows
+            # the carried state (the error-budget argument).
+            coords1 = coords1 + delta.astype(policy.coord_jnp)
 
             if test_mode:
                 out = None
@@ -420,7 +447,7 @@ class RAFT:
         if test_mode:
             flow_up = upsample_prediction(
                 coords1, net, final_stats.get("up_mask")
-            )
+            ).astype(policy.output_jnp)  # serving/metrics contract: f32
             if metric_head is not None:
                 flow_up = metric_head(flow_up)
             if return_net:

@@ -24,6 +24,18 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+# Policy-pinned dtypes (raft_ncup_tpu/precision/; docs/PRECISION.md).
+# PARAM_DTYPE: master-weight storage — every PrecisionPolicy preset pins
+# param_dtype to f32 (the policy constructor rejects anything else), so
+# this module constant IS the policy's param dtype; modules cast params
+# to the per-module compute ``dtype`` at use. NORM_DTYPE: normalization
+# statistics always compute in f32 (PrecisionPolicy.norm_jnp pins it) —
+# the standard mixed-precision exception. graftlint JGL009 forbids raw
+# inline dtype literals in nn/ bodies; these named constants are the
+# sanctioned routing.
+PARAM_DTYPE = jnp.float32
+NORM_DTYPE = jnp.float32
+
 
 def _pair(v) -> tuple[int, int]:
     if isinstance(v, (tuple, list)):
@@ -32,7 +44,7 @@ def _pair(v) -> tuple[int, int]:
 
 
 def _uniform_init(bound: float):
-    def init(key, shape, dtype=jnp.float32):
+    def init(key, shape, dtype=PARAM_DTYPE):
         return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
 
     return init
@@ -72,7 +84,7 @@ class Conv2d(nn.Module):
             raise ValueError(f"unknown init_mode: {self.init_mode!r}")
 
         kernel = self.param(
-            "kernel", kinit, (kh, kw, cin // self.groups, self.features), jnp.float32
+            "kernel", kinit, (kh, kw, cin // self.groups, self.features), PARAM_DTYPE
         )
 
         if self.padding is None:
@@ -102,7 +114,7 @@ class Conv2d(nn.Module):
                 "bias",
                 _uniform_init(1.0 / math.sqrt(fan_in)),
                 (self.features,),
-                jnp.float32,
+                PARAM_DTYPE,
             )
             y = y + bias.astype(cdt)
         return y
@@ -135,7 +147,7 @@ class ConvTranspose2d(nn.Module):
             "kernel",
             _uniform_init(math.sqrt(1.0 / fan_in)),
             (kh, kw, self.features, cin),
-            jnp.float32,
+            PARAM_DTYPE,
         )
         cdt = self.dtype or x.dtype
         y = jax.lax.conv_transpose(
@@ -151,7 +163,7 @@ class ConvTranspose2d(nn.Module):
                 "bias",
                 _uniform_init(1.0 / math.sqrt(fan_in)),
                 (self.features,),
-                jnp.float32,
+                PARAM_DTYPE,
             )
             y = y + bias.astype(cdt)
         return y
@@ -177,7 +189,7 @@ class Norm(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
         in_dtype = x.dtype
-        x32 = x.astype(jnp.float32)
+        x32 = x.astype(NORM_DTYPE)
         if self.kind == "none":
             return x
         if self.kind == "group":

@@ -23,6 +23,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from raft_ncup_tpu.nn.layers import PARAM_DTYPE
 from raft_ncup_tpu.ops.geometry import upsample_nearest
 from raft_ncup_tpu.ops.nconv import downsample_data_conf, nconv2d, positivity
 
@@ -51,12 +52,12 @@ class NConv2dLayer(nn.Module):
         cin = data.shape[-1]
         n = k * k * self.features
 
-        def raw_init(key, shape, dtype=jnp.float32):
+        def raw_init(key, shape, dtype=PARAM_DTYPE):
             w = 2.0 + math.sqrt(2.0 / n) * jax.random.normal(key, shape, dtype)
             return positivity(w, self.pos_fn)
 
         raw = self.param(
-            "weight_p", raw_init, (k, k, cin // self.groups, self.features), jnp.float32
+            "weight_p", raw_init, (k, k, cin // self.groups, self.features), PARAM_DTYPE
         )
         weight = positivity(raw, self.pos_fn)
 
@@ -65,10 +66,10 @@ class NConv2dLayer(nn.Module):
             fan_in = (cin // self.groups) * k * k
             bound = 1.0 / math.sqrt(fan_in)
 
-            def bias_init(key, shape, dtype=jnp.float32):
+            def bias_init(key, shape, dtype=PARAM_DTYPE):
                 return jax.random.uniform(key, shape, dtype, -bound, bound)
 
-            bias = self.param("bias", bias_init, (self.features,), jnp.float32)
+            bias = self.param("bias", bias_init, (self.features,), PARAM_DTYPE)
 
         return nconv2d(
             data, conf, weight, bias, groups=self.groups, propagate_conf=True

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_ncup_tpu.config import UpsamplerConfig
-from raft_ncup_tpu.nn.layers import Conv2d
+from raft_ncup_tpu.nn.layers import PARAM_DTYPE, Conv2d
 from raft_ncup_tpu.ops.pac import (
     extract_patches,
     pac_gaussian_kernel,
@@ -259,7 +259,7 @@ class PacConvTranspose2d(nn.Module, _PacKernelMixin):
         eye = np.zeros((k * k, self.in_ch, self.out_ch), np.float32)
         for c in range(min(self.in_ch, self.out_ch)):
             eye[:, c, c] = w2
-        return jnp.asarray(eye, jnp.float32)
+        return jnp.asarray(eye, PARAM_DTYPE)
 
     @nn.compact
     def __call__(self, x: jax.Array, guide: jax.Array) -> jax.Array:

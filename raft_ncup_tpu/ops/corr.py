@@ -64,19 +64,29 @@ def _delta_window(radius: int, dtype=jnp.float32) -> jax.Array:
 
 
 def build_corr_pyramid(
-    fmap1: jax.Array, fmap2: jax.Array, num_levels: int = 4
+    fmap1: jax.Array, fmap2: jax.Array, num_levels: int = 4, dtype=None
 ) -> CorrPyramid:
     """Compute the all-pairs correlation volume and its average pyramid.
 
     Args:
-      fmap1, fmap2: (B, H, W, C) feature maps (cast to float32 like the
-        reference's ``fmap.float()`` at core/raft.py:103-104).
+      fmap1, fmap2: (B, H, W, C) feature maps (cast to ``dtype``, default
+        float32 like the reference's ``fmap.float()`` at
+        core/raft.py:103-104).
+      dtype: storage dtype of the volume — the dominant memory term, so
+        the precision policy's bf16 presets halve it here
+        (``PrecisionPolicy.corr_jnp``). The dot products ACCUMULATE in
+        f32 regardless (``preferred_element_type``); only storage
+        narrows. Lookup arithmetic re-widens via ``grid_sample``'s
+        promotion, so coordinates never demote.
     """
     B, H, W, C = fmap1.shape
-    f1 = fmap1.reshape(B, H * W, C).astype(jnp.float32)
-    f2 = fmap2.reshape(B, H * W, C).astype(jnp.float32)
-    corr = jnp.einsum("bxc,byc->bxy", f1, f2) / math.sqrt(C)
-    corr = corr.reshape(B, H * W, H, W)
+    dtype = dtype or jnp.float32
+    f1 = fmap1.reshape(B, H * W, C).astype(dtype)
+    f2 = fmap2.reshape(B, H * W, C).astype(dtype)
+    corr = jnp.einsum(
+        "bxc,byc->bxy", f1, f2, preferred_element_type=jnp.float32
+    ) / math.sqrt(C)
+    corr = corr.astype(dtype).reshape(B, H * W, H, W)
 
     levels = [corr]
     for _ in range(num_levels - 1):
@@ -95,7 +105,9 @@ def corr_lookup(pyramid: CorrPyramid, coords: jax.Array, radius: int) -> jax.Arr
       pyramid: from :func:`build_corr_pyramid`.
       coords: (B, H, W, 2) query positions in fmap2 pixel coordinates.
     Returns:
-      (B, H, W, L * (2r+1)^2) float32, level-major then window-tap order.
+      (B, H, W, L * (2r+1)^2) at the promoted (volume, coords) dtype —
+      float32 whenever coords are f32 (the policy's coord contract),
+      level-major then window-tap order.
     """
     B, H, W, _ = coords.shape
     K = 2 * radius + 1
@@ -136,6 +148,7 @@ def corr_lookup_onthefly(
     num_levels: int = 4,
     row_chunk: int = 8,
     levels: Sequence[int] | None = None,
+    dtype=None,
 ) -> jax.Array:
     """Windowed correlation lookup without materializing the volume.
 
@@ -151,13 +164,18 @@ def corr_lookup_onthefly(
       levels: pyramid level indices to compute (default: all
         ``num_levels``); the Pallas dispatcher uses this to source only
         the levels whose slab exceeds its VMEM budget.
+      dtype: feature/pyramid dtype (default f32; the precision policy's
+        ``corr_jnp`` under bf16 presets — halves the resident pyramid).
+        The tap sampling promotes back through the f32 coords and the
+        contraction accumulates in f32, so the output stays f32.
     """
     B, H, W, C = fmap1.shape
     K = 2 * radius + 1
     scale = 1.0 / math.sqrt(C)
+    dtype = dtype or jnp.float32
     level_ids = tuple(range(num_levels)) if levels is None else tuple(levels)
-    f2_levels = _pool_fmap_pyramid(fmap2.astype(jnp.float32), num_levels)
-    f1 = fmap1.astype(jnp.float32)
+    f2_levels = _pool_fmap_pyramid(fmap2.astype(dtype), num_levels)
+    f1 = fmap1.astype(dtype)
     delta = _delta_window(radius)
 
     pad_rows = (-H) % row_chunk
@@ -175,7 +193,10 @@ def corr_lookup_onthefly(
             centroid = coords_chunk[:, :, :, None, None, :] / (2**lvl)
             taps = centroid + delta[None, None, None]  # (B, rc, W, K, K, 2)
             sampled = grid_sample(f2_levels[lvl], taps)  # (B, rc, W, K, K, C)
-            corr = jnp.einsum("brwijc,brwc->brwij", sampled, f1_chunk) * scale
+            corr = jnp.einsum(
+                "brwijc,brwc->brwij", sampled, f1_chunk,
+                preferred_element_type=jnp.float32,
+            ) * scale
             per_level.append(corr.reshape(*corr.shape[:3], K * K))
         return carry, jnp.concatenate(per_level, axis=-1)
 

@@ -100,26 +100,41 @@ def _padded_hw(h: int, w: int, radius: int) -> tuple[int, int, int]:
 
 
 def _level_vmem_bytes(
-    h: int, w: int, channels: int, radius: int, query_block: int = _QUERY_BLOCK
+    h: int,
+    w: int,
+    channels: int,
+    radius: int,
+    query_block: int = _QUERY_BLOCK,
+    itemsize: int = 4,
 ) -> int:
     """Bytes of VMEM the kernel needs for one (h, w) level: the resident
-    padded fmap2 slab + double-buffered query blocks + the group scratch."""
+    padded fmap2 slab + double-buffered query blocks + the group scratch,
+    all at ``itemsize`` bytes per element (the precision policy's
+    compute dtype — 2 under the bf16 presets, which is exactly the
+    dispatch-threshold doubling ROADMAP item 3 wanted; the frac/out
+    blocks stay f32 but are a few percent of the slab, so budgeting them
+    at ``itemsize`` keeps the threshold ratio an exact itemsize ratio)."""
     hp, wp, _ = _padded_hw(h, w, radius)
     K1 = 2 * radius + 2
     slab = hp * wp * channels
     blocks = 2 * query_block * (channels + 2 + (K1 - 1) ** 2)  # f1+frac+out, x2 pipeline
     scratch = _GROUP * K1 * K1 * channels
-    return 4 * (slab + blocks + scratch)
+    return itemsize * (slab + blocks + scratch)
 
 
 def fits_vmem(
-    h: int, w: int, channels: int, radius: int = 4
+    h: int, w: int, channels: int, radius: int = 4, dtype=None
 ) -> bool:
     """Whether a (h, w, channels) fmap2 LEVEL fits the kernel's VMEM
-    budget. Dispatch inside :func:`corr_lookup_pallas` applies this per
-    pyramid level; callers gating on the full-res shape get the level-0
-    answer."""
-    return _level_vmem_bytes(h, w, channels, radius) <= int(0.9 * _VMEM_BYTES)
+    budget at ``dtype``'s element size (default float32). Dispatch
+    inside :func:`corr_lookup_pallas` applies this per pyramid level at
+    the precision policy's corr dtype — bf16 halves every per-level
+    byte count, so levels rejected at f32 can stay on-chip; callers
+    gating on the full-res shape get the level-0 answer."""
+    itemsize = 4 if dtype is None else int(jnp.dtype(dtype).itemsize)
+    return _level_vmem_bytes(
+        h, w, channels, radius, itemsize=itemsize
+    ) <= int(0.9 * _VMEM_BYTES)
 
 
 def _lookup_kernel(
@@ -129,13 +144,17 @@ def _lookup_kernel(
 
     ibase_ref:   (Q, 2) int32, SMEM — clamped window origins (x, y) in the
                  padded level.
-    f1_ref:      (Q, C) float32 — query features, pre-scaled by 1/sqrt(C).
+    f1_ref:      (Q, C) compute dtype — query features, pre-scaled by
+                 1/sqrt(C).
     frac_ref:    (Q, 2) float32 — sub-pixel offsets (fx, fy).
-    f2_ref:      (Hp, Wp, C) float32 — zero-padded fmap2 level.
+    f2_ref:      (Hp, Wp, C) compute dtype — zero-padded fmap2 level
+                 (bf16 under the bf16 policies: the resident slab is the
+                 VMEM term, so narrow STORAGE is the dispatch-threshold
+                 win; the reduce below upcasts, so ACCUMULATION is f32).
     out_ref:     (Q, K, K) float32 — window values in natural (y, x) order;
                  the caller transposes to the reference's x-major tap order
                  (core/corr.py:31-37).
-    scratch_ref: (G, K+1, K+1, C) float32 VMEM scratch.
+    scratch_ref: (G, K+1, K+1, C) compute-dtype VMEM scratch.
     """
     K = 2 * radius + 1
     G = _GROUP
@@ -148,8 +167,8 @@ def _lookup_kernel(
             ix = ibase_ref[base + g, 0]
             iy = ibase_ref[base + g, 1]
             scratch_ref[g] = f2_ref[pl.ds(iy, K + 1), pl.ds(ix, K + 1), :]
-        patch = scratch_ref[...]  # (G, K+1, K+1, C)
-        f1g = f1_ref[pl.ds(base, G), :]  # (G, C)
+        patch = scratch_ref[...].astype(jnp.float32)  # (G, K+1, K+1, C)
+        f1g = f1_ref[pl.ds(base, G), :].astype(jnp.float32)  # (G, C)
         corr = jnp.sum(patch * f1g[:, None, None, :], axis=-1)  # (G,K+1,K+1)
         fr = frac_ref[pl.ds(base, G), :]  # (G, 2)
         fx = fr[:, 0][:, None, None]
@@ -177,6 +196,9 @@ def _lookup_one_level(
 ) -> jax.Array:
     B, N, C = f1.shape
     _, Hl, Wl, _ = f2l.shape
+    # Feature operands keep their (policy-chosen) dtype end to end: the
+    # VMEM-resident slab and the f1 blocks are what the budget counts.
+    fdt = f1.dtype
     K = 2 * radius + 1
     Hp, Wp, pad = _padded_hw(Hl, Wl, radius)
     f2p = jnp.pad(f2l, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
@@ -215,7 +237,7 @@ def _lookup_one_level(
     out = pl.pallas_call(
         functools.partial(_lookup_kernel, radius=radius),
         grid=(B, n_blocks),
-        scratch_shapes=[pltpu.VMEM((_GROUP, K1, K1, C), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((_GROUP, K1, K1, C), fdt)],
         in_specs=[
             ibase_spec,
             pl.BlockSpec((None, qblk, C), lambda b, i: (b, i, 0)),
@@ -227,9 +249,9 @@ def _lookup_one_level(
         interpret=interpret,
     )(
         ibase,
-        f1.astype(jnp.float32),
+        f1.astype(fdt),
         frac.astype(jnp.float32),
-        f2p.astype(jnp.float32),
+        f2p.astype(fdt),
     )
     # (B, N, K_y, K_x) -> x-major taps (reference order).
     return out[:, :N].transpose(0, 1, 3, 2).reshape(B, N, K * K)
@@ -242,16 +264,20 @@ def _forward(
     radius: int,
     num_levels: int,
     interpret: bool = False,
+    dtype=None,
 ) -> jax.Array:
     """Volume-free fused lookup over all pyramid levels, with PER-LEVEL
-    dispatch: levels whose padded slab fits VMEM take the kernel, the rest
-    take the equivalent XLA on-the-fly path (1080p levels 0-1)."""
+    dispatch: levels whose padded slab fits VMEM at ``dtype``'s element
+    size take the kernel, the rest take the equivalent XLA on-the-fly
+    path (1080p levels 0-1 at f32; level 1 re-qualifies at bf16 —
+    tests/test_precision.py pins the threshold ratio)."""
     from raft_ncup_tpu.ops.corr import _pool_fmap_pyramid, corr_lookup_onthefly
 
     B, H, W, C = fmap1.shape
     scale = 1.0 / math.sqrt(C)
-    f1 = (fmap1.reshape(B, H * W, C) * scale).astype(jnp.float32)
-    f2_levels = _pool_fmap_pyramid(fmap2.astype(jnp.float32), num_levels)
+    dtype = jnp.dtype(dtype) if dtype is not None else jnp.float32
+    f1 = (fmap1.reshape(B, H * W, C) * scale).astype(dtype)
+    f2_levels = _pool_fmap_pyramid(fmap2.astype(dtype), num_levels)
     cflat = coords.astype(jnp.float32).reshape(B, H * W, 2)
 
     K2 = (2 * radius + 1) ** 2
@@ -272,7 +298,7 @@ def _forward(
         )
     for lvl, f2l in enumerate(f2_levels):
         if pltpu is not None and fits_vmem(
-            f2l.shape[1], f2l.shape[2], C, radius
+            f2l.shape[1], f2l.shape[2], C, radius, dtype=dtype
         ):
             _dispatch_counts["kernel"] += 1
             outs[lvl] = _lookup_one_level(
@@ -295,7 +321,8 @@ def _forward(
                 stacklevel=2,
             )
         fb = corr_lookup_onthefly(
-            fmap1, fmap2, coords, radius, num_levels, levels=tuple(fallback)
+            fmap1, fmap2, coords, radius, num_levels, levels=tuple(fallback),
+            dtype=dtype,
         ).reshape(B, H * W, len(fallback) * K2)
         for j, lvl in enumerate(fallback):
             outs[lvl] = fb[..., j * K2 : (j + 1) * K2]
@@ -305,7 +332,7 @@ def _forward(
     ).reshape(B, H, W, num_levels * K2)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def corr_lookup_pallas(
     fmap1: jax.Array,
     fmap2: jax.Array,
@@ -313,20 +340,29 @@ def corr_lookup_pallas(
     radius: int,
     num_levels: int = 4,
     interpret: bool = False,
+    dtype=None,
 ) -> jax.Array:
     """Fused correlation lookup: (B,H,W,C) x2 + (B,H,W,2) ->
-    (B, H, W, L*(2r+1)^2). Equivalent to the XLA paths in
+    (B, H, W, L*(2r+1)^2) float32. Equivalent to the XLA paths in
     ``raft_ncup_tpu.ops.corr`` up to float associativity; never
-    materializes the correlation volume."""
-    return _forward(fmap1, fmap2, coords, radius, num_levels, interpret)
+    materializes the correlation volume. ``dtype`` (static; default
+    f32) is the feature/slab dtype the per-level VMEM dispatch budgets
+    with — the precision policy's ``corr_jnp``. The backward always
+    differentiates the f32 XLA path: gradients stay full precision
+    regardless of the forward's storage dtype (f32 master weights)."""
+    return _forward(
+        fmap1, fmap2, coords, radius, num_levels, interpret, dtype
+    )
 
 
-def _fwd(fmap1, fmap2, coords, radius, num_levels, interpret):
-    out = _forward(fmap1, fmap2, coords, radius, num_levels, interpret)
+def _fwd(fmap1, fmap2, coords, radius, num_levels, interpret, dtype):
+    out = _forward(
+        fmap1, fmap2, coords, radius, num_levels, interpret, dtype
+    )
     return out, (fmap1, fmap2, coords)
 
 
-def _bwd(radius, num_levels, interpret, res, g):
+def _bwd(radius, num_levels, interpret, dtype, res, g):
     from raft_ncup_tpu.ops.corr import corr_lookup_onthefly
 
     fmap1, fmap2, coords = res

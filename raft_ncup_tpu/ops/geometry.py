@@ -46,8 +46,15 @@ def grid_sample(img: jax.Array, coords: jax.Array) -> jax.Array:
       (B, ..., C) sampled values.
     """
     B, H, W, C = img.shape
-    x = coords[..., 0].astype(img.dtype)
-    y = coords[..., 1].astype(img.dtype)
+    # Coordinate/weight arithmetic runs at the WIDER of the two dtypes:
+    # a narrow-storage image (the bf16 correlation volume under the
+    # precision policy, docs/PRECISION.md) must not demote the query
+    # coordinates — bf16 cannot represent integer pixel positions above
+    # 256, and the policy pins coord_dtype to f32. For the historical
+    # f32/f32 call the promotion is the identity.
+    wdt = jnp.promote_types(img.dtype, coords.dtype)
+    x = coords[..., 0].astype(wdt)
+    y = coords[..., 1].astype(wdt)
 
     x0 = jnp.floor(x)
     y0 = jnp.floor(y)
@@ -57,7 +64,7 @@ def grid_sample(img: jax.Array, coords: jax.Array) -> jax.Array:
     flat_img = img.reshape(B, H * W, C)
     batch_shape = x.shape  # (B, ...)
 
-    out = jnp.zeros(batch_shape + (C,), dtype=img.dtype)
+    out = jnp.zeros(batch_shape + (C,), dtype=wdt)
     taps = (
         (x0, y0, (1.0 - dx) * (1.0 - dy)),
         (x0 + 1.0, y0, dx * (1.0 - dy)),

@@ -1,0 +1,33 @@
+"""Precision-policy subsystem (docs/PRECISION.md).
+
+One frozen ``PrecisionPolicy`` is the single authority for every dtype
+on the hot path — model compute, correlation volume, Pallas VMEM
+budgeting, streaming slot-table state — with named presets selected by
+``ModelConfig.precision`` / ``ServeConfig.precision`` /
+``StreamConfig.precision`` / ``TrainConfig.precision`` and enforced by
+graftlint JGL009 (no raw dtype literals in hot-path modules).
+"""
+
+from raft_ncup_tpu.precision.policy import (
+    BF16_INFER,
+    BF16_TRAIN,
+    F32,
+    FORWARD_EPE_BUDGET,
+    PRESET_NAMES,
+    PRESETS,
+    TRAIN_LOSS_RTOL,
+    PrecisionPolicy,
+    resolve_policy,
+)
+
+__all__ = [
+    "BF16_INFER",
+    "BF16_TRAIN",
+    "F32",
+    "FORWARD_EPE_BUDGET",
+    "PRESETS",
+    "PRESET_NAMES",
+    "TRAIN_LOSS_RTOL",
+    "PrecisionPolicy",
+    "resolve_policy",
+]

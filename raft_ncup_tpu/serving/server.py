@@ -100,8 +100,13 @@ class FlowServer:
         self.cfg = cfg or ServeConfig()
         self._clock = clock
         self.stats = ServeStats()
+        # The per-ServeConfig precision policy (docs/PRECISION.md): every
+        # compiled serving program — warmup set included — runs under it,
+        # and its fingerprint rides every executable key. None inherits
+        # the model's own policy (ShapeCachedForward's default).
         self._fwd = ShapeCachedForward(
-            model, variables, mesh=mesh, cache_size=self.cfg.cache_size
+            model, variables, mesh=mesh, cache_size=self.cfg.cache_size,
+            policy=self.cfg.precision,
         )
         self._queue = AdmissionQueue(self.cfg.queue_capacity)
         self.budget = IterationBudgetController(
@@ -440,6 +445,7 @@ class FlowServer:
             "budget_drops": self.budget.drops,
             "budget_recoveries": self.budget.recoveries,
             "executables": dict(self._fwd.stats),
+            "precision": self._fwd.policy.name,  # RESOLVED (None inherits)
         }
 
     def __enter__(self) -> "FlowServer":

@@ -164,12 +164,25 @@ class StreamEngine:
         self._hidden = (
             model.cfg.hidden_dim if self.cfg.carry_net else 0
         )
+        # Per-engine precision policy (docs/PRECISION.md): the step
+        # programs compile under it and the slot table's recurrent state
+        # is STORED at its state dtype (bf16 presets halve per-stream
+        # HBM; the step upcasts to the pinned f32 coord dtype before the
+        # splat). None inherits the model's own policy.
+        from raft_ncup_tpu.precision import resolve_policy
+
+        self._policy = (
+            resolve_policy(self.cfg.precision)
+            if self.cfg.precision is not None
+            else resolve_policy(getattr(model, "policy", None))
+        )
         # The device slot table. Owned by the dispatcher thread after
         # construction: every step call donates it and replaces the
         # reference with the program's output, so exactly one live copy
         # exists in HBM.
         self._table = init_slot_table(
-            self.cfg.capacity, self._h8, self._w8, self._hidden
+            self.cfg.capacity, self._h8, self._w8, self._hidden,
+            dtype=self._policy.state_jnp,
         )
         # Serializes every step invocation that donates the table: the
         # dispatcher owns it in steady state, but warmup() also runs
@@ -177,7 +190,8 @@ class StreamEngine:
         # would be a use-after-donate.
         self._table_lock = threading.Lock()
         self._fwd = ShapeCachedForward(
-            model, variables, cache_size=self.cfg.cache_size
+            model, variables, cache_size=self.cfg.cache_size,
+            policy=self._policy,
         )
         self._queue = AdmissionQueue(self.cfg.queue_capacity)
         self._throttle = DispatchThrottle(self.cfg.inflight)
@@ -414,7 +428,10 @@ class StreamEngine:
         """The compiled slot-table step for one batch size (compiled
         once per size; ``ShapeCachedForward.custom`` accounts it)."""
         cfg = self.cfg
-        model = self._fwd.model
+        # The policy-resolved model: the engine's forward computes at
+        # the engine policy's dtypes regardless of which preset the
+        # caller's model instance was built under.
+        model, policy = self._fwd.model_for()
 
         def build():
             import jax
@@ -426,9 +443,15 @@ class StreamEngine:
 
             iters, thresh = cfg.iters, cfg.anomaly_max_flow
             carry_net = bool(self._hidden)
+            state_dt = policy.state_jnp
 
             def fn(v, table, img1, img2, slot_idx, cold):
-                prev_flow = table["flow"][slot_idx]  # (B, h8, w8, 2)
+                # Storage is (possibly) narrow; the warm-start splat is
+                # coordinate arithmetic, so it runs at the policy's
+                # pinned f32 coord dtype.
+                prev_flow = table["flow"][slot_idx].astype(
+                    policy.coord_jnp
+                )  # (B, h8, w8, 2)
                 warm = (
                     table["warm"][slot_idx] * (1.0 - cold) > 0.5
                 )  # (B,) bool
@@ -459,14 +482,19 @@ class StreamEngine:
                 good = ~bad
                 gm = good[:, None, None, None]
                 new_table = dict(table)
+                # Scatter back at the table's STORAGE dtype (donation
+                # needs matching avals; bf16 presets narrow here).
+                new_flow = jnp.where(
+                    gm, flow_lr, jnp.zeros_like(flow_lr)
+                ).astype(state_dt)
                 new_table["flow"] = table["flow"].at[slot_idx].set(
-                    jnp.where(gm, flow_lr, jnp.zeros_like(flow_lr))
+                    new_flow
                 )
                 new_table["warm"] = table["warm"].at[slot_idx].set(
-                    good.astype(jnp.float32)
+                    good.astype(table["warm"].dtype)
                 )
                 if carry_net:
-                    netf = net_f.astype(jnp.float32)
+                    netf = net_f.astype(state_dt)
                     new_table["net"] = table["net"].at[slot_idx].set(
                         jnp.where(gm, netf, jnp.zeros_like(netf))
                     )
@@ -476,7 +504,9 @@ class StreamEngine:
             # place, so exactly one table lives in HBM.
             return jax.jit(fn, donate_argnums=(1,))
 
-        return self._fwd.custom(("stream", n_rows), build)
+        return self._fwd.custom(
+            ("stream", n_rows, policy.fingerprint()), build
+        )
 
     def _process(self, batch: list) -> None:
         import jax.numpy as jnp
@@ -703,6 +733,7 @@ class StreamEngine:
             "mean_occupancy": round(self._occupancy_sum / batches, 2),
             "evicted": evicted,
             "executables": dict(self._fwd.stats),
+            "precision": self._policy.name,  # RESOLVED (None inherits)
         }
 
     def __enter__(self) -> "StreamEngine":

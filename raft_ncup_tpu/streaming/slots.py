@@ -43,22 +43,31 @@ import jax.numpy as jnp
 
 
 def init_slot_table(
-    capacity: int, h8: int, w8: int, hidden_dim: int = 0
+    capacity: int, h8: int, w8: int, hidden_dim: int = 0, dtype=None
 ) -> dict:
     """Fresh all-cold device slot table for ``capacity`` streams.
 
     Arrays are sized ``capacity + 1``: the extra row is the scratch slot
     batch padding targets. ``warm`` is float32 0/1 (it multiplies into
-    masks in-graph); everything starts cold, so a freshly admitted
-    stream's first frame is bitwise a cold start regardless of history.
+    masks in-graph — a flag, not recurrent numerics, so it never
+    narrows); everything starts cold, so a freshly admitted stream's
+    first frame is bitwise a cold start regardless of history.
+
+    ``dtype`` (default f32) is the recurrent-STATE storage dtype — the
+    precision policy's ``state_jnp``: under the bf16 presets the
+    per-stream flow (and optional GRU net) rows are stored bf16, halving
+    the table's HBM footprint; the engine's step upcasts to the policy's
+    pinned f32 coord dtype before the warm-start splat, so storage is
+    narrow but coordinate arithmetic is not (docs/PRECISION.md).
     """
+    dtype = dtype or jnp.float32
     table = {
-        "flow": jnp.zeros((capacity + 1, h8, w8, 2), jnp.float32),
+        "flow": jnp.zeros((capacity + 1, h8, w8, 2), dtype),
         "warm": jnp.zeros((capacity + 1,), jnp.float32),
     }
     if hidden_dim:
         table["net"] = jnp.zeros(
-            (capacity + 1, h8, w8, hidden_dim), jnp.float32
+            (capacity + 1, h8, w8, hidden_dim), dtype
         )
     return table
 

@@ -34,7 +34,9 @@ def recommend(record: dict) -> list[str]:
             "no accelerator measurement in this record "
             f"(baseline_key={key or 'absent'!r}); defaults stay "
             "corr_impl='volume', RAFT_NCUP_NCONV_IMPL='xla' pending TPU data"
-        ] + _val_row_lines(record) + _serve_row_lines(record)
+        ] + _val_row_lines(record) + _serve_row_lines(record) + _bf16_row_lines(
+            record
+        )
 
     corr = {"volume": record.get("value")}
     for tag in ("onthefly", "pallas"):
@@ -98,6 +100,7 @@ def recommend(record: dict) -> list[str]:
 
     lines.extend(_val_row_lines(record))
     lines.extend(_serve_row_lines(record))
+    lines.extend(_bf16_row_lines(record))
 
     nc = record.get("pairs_per_sec_nconv_pallas")
     fell_back = record.get("pairs_per_sec_nconv_pallas_FELL_BACK_TO_XLA")
@@ -176,6 +179,96 @@ def _val_row_lines(record: dict) -> list[str]:
         f"val_loop: no stall recovered on this host ({stall:.1f} ms/pair; "
         "saturated-host or accelerator-absent measurement) — pipeline "
         "stays on for the invariants; judge speed on accelerator rows"
+    ]
+
+
+def _bf16_row_lines(record: dict) -> list[str]:
+    """bf16 precision rows (bench.py ``*_bf16`` fields; docs/PRECISION.md)
+    — the corr_impl flip discipline applied to the precision default:
+    absent row → no lines (older records predate it); any ``*_bf16``
+    guard counter nonzero → the numbers measured a leaking/recompiling
+    program and are unusable; parity over the recorded budget → never
+    flip, regardless of speed; clean + parity met → flip only on
+    accelerator data with a >= MARGIN throughput win (CPU emulates bf16
+    in software — its ordering says nothing about the MXU)."""
+    bf16 = record.get("pairs_per_sec_bf16")
+    if bf16 is None and not any("bf16" in k for k in record):
+        return []
+    # Any bf16-window guard counter, wherever 'bf16' sits in the key:
+    # the forward row spells them fwd_bf16_recompiles (prefix), the
+    # val/serve/stream rows val_loop_recompiles_bf16 (suffix). These
+    # filters run even when the forward row is MISSING — the sub-rows
+    # are measured independently (a failed forward row does not stop
+    # bench's later bf16 rows), and dirty numbers without an 'unusable'
+    # flag are exactly the misread this function exists to prevent.
+    dirty = {
+        k: v
+        for k, v in record.items()
+        if "bf16" in k
+        and ("recompiles" in k or "host_transfers" in k)
+        and v
+    }
+    if dirty:
+        return [
+            "bf16: INVARIANT VIOLATED during bf16 window(s) "
+            f"({dirty}) — the *_bf16 numbers measure a leaking or "
+            "recompiling program; fix the leak (docs/ANALYSIS.md) "
+            "before reading them, and do NOT flip the precision default"
+        ]
+    failed = {
+        k: v
+        for k, v in record.items()
+        if "bf16" in k and "errors" in k and v
+    }
+    if failed:
+        return [
+            f"bf16: window(s) ERRORED ({failed}) — the *_bf16 numbers "
+            "cover a partial sample; fix the failure and rerun bench "
+            "before judging the precision default"
+        ]
+    if bf16 is None:
+        return [
+            "bf16: forward row missing (other *_bf16 rows recorded, "
+            "invariants clean); rerun bench for the bf16 forward row — "
+            "no parity measurement, no flip verdict"
+        ]
+    parity = record.get("bf16_forward_epe_vs_f32")
+    budget = record.get("bf16_epe_budget")
+    if parity is None or budget is None:
+        return [
+            "bf16: row incomplete (no parity measurement); rerun bench "
+            "for the bf16 forward row before judging the precision "
+            "default"
+        ]
+    if parity > budget:
+        return [
+            f"bf16: parity budget EXCEEDED ({parity:.4f} px EPE vs f32, "
+            f"budget {budget:.4f}) — do NOT flip the precision default; "
+            "investigate the drift (docs/PRECISION.md error-budget "
+            "methodology) before trusting bf16 numbers"
+        ]
+    base = record.get("value")
+    key = str(record.get("baseline_key", ""))
+    on_accel = bool(key) and not key.startswith("cpu")
+    if on_accel and base and bf16 >= MARGIN * base:
+        return [
+            f"precision: FLIP default 'f32' -> 'bf16_infer' "
+            f"({bf16:.2f} vs {base:.2f} pairs/s, parity {parity:.4f} px "
+            f"within budget {budget:.4f}, invariants clean; edit "
+            "raft_ncup_tpu/config.py ModelConfig.precision — and retest "
+            "bf16_train before flipping the training default)"
+        ]
+    if on_accel:
+        return [
+            f"bf16: parity within budget ({parity:.4f} px) but no >= "
+            f"{MARGIN:.2f}x win ({bf16:.2f} vs {base or 0:.2f} pairs/s); "
+            "keep precision 'f32'"
+        ]
+    return [
+        f"bf16: parity within budget ({parity:.4f} px, invariants "
+        f"clean) on a CPU row ({bf16:.2f} vs {base or 0:.2f} pairs/s, "
+        "bf16 emulated) — no flip from CPU data; rows are staged for "
+        "first hardware contact"
     ]
 
 

@@ -135,7 +135,7 @@ class TestPallasLookup:
         dispatched = []
         real_fits = cpk.fits_vmem
 
-        def fits(h, w, c, radius=4):
+        def fits(h, w, c, radius=4, dtype=None):
             ok = cpk._level_vmem_bytes(h, w, c, radius) < level0_bytes
             dispatched.append(((h, w), ok))
             return ok

@@ -245,3 +245,98 @@ class TestMain:
     def test_non_json_input_fails_loudly(self, capsys, monkeypatch):
         with pytest.raises(SystemExit):
             self._run(capsys, monkeypatch, "not json at all")
+
+
+class TestBf16Row:
+    """Precision-default policy for the ``*_bf16`` rows (docs/PRECISION.md):
+    absent → silent; dirty guard counters → unusable; parity over budget
+    → never flip; clean + parity + margin on ACCELERATOR data → flip."""
+
+    def _bf16(self, **kw):
+        rec = dict(
+            pairs_per_sec_bf16=150.0,
+            bf16_forward_epe_vs_f32=0.02,
+            bf16_epe_budget=0.5,
+            fwd_bf16_recompiles=0,
+            fwd_bf16_host_transfers=0,
+        )
+        rec.update(kw)
+        return rec
+
+    def test_absent_bf16_row_adds_no_lines(self):
+        lines = flip.recommend(_tpu())
+        assert not any("bf16" in ln for ln in lines)
+
+    def test_dirty_guard_counters_make_row_unusable(self):
+        lines = flip.recommend(
+            _tpu(**self._bf16(val_loop_recompiles_bf16=2))
+        )
+        (ln,) = [x for x in lines if x.startswith("bf16:")]
+        assert "INVARIANT VIOLATED" in ln and "do NOT flip" in ln
+
+    def test_parity_over_budget_blocks_flip(self):
+        lines = flip.recommend(
+            _tpu(**self._bf16(bf16_forward_epe_vs_f32=0.9))
+        )
+        (ln,) = [x for x in lines if x.startswith("bf16:")]
+        assert "EXCEEDED" in ln and "do NOT flip" in ln
+
+    def test_missing_parity_is_incomplete(self):
+        rec = self._bf16()
+        del rec["bf16_forward_epe_vs_f32"]
+        lines = flip.recommend(_tpu(**rec))
+        (ln,) = [x for x in lines if x.startswith("bf16:")]
+        assert "incomplete" in ln
+
+    def test_clean_accelerator_win_flips_precision_default(self):
+        lines = flip.recommend(_tpu(**self._bf16()))
+        (ln,) = [x for x in lines if x.startswith("precision:")]
+        assert "FLIP default 'f32' -> 'bf16_infer'" in ln
+        assert "ModelConfig.precision" in ln
+
+    def test_clean_accelerator_without_margin_keeps_f32(self):
+        lines = flip.recommend(
+            _tpu(**self._bf16(pairs_per_sec_bf16=101.0))
+        )
+        (ln,) = [x for x in lines if x.startswith("bf16:")]
+        assert "keep precision 'f32'" in ln
+
+    def test_cpu_row_reports_parity_but_never_flips(self):
+        rec = {"value": 9.0,
+               "baseline_key": "cpu@host:volume:1x96x128x4"}
+        rec.update(self._bf16(pairs_per_sec_bf16=20.0))
+        lines = flip.recommend(rec)
+        assert not any(x.startswith("precision:") for x in lines)
+        (ln,) = [x for x in lines if x.startswith("bf16:")]
+        assert "no flip from CPU data" in ln
+
+    def test_forward_row_guard_counters_also_block(self):
+        """fwd_bf16_* spell the guard counters prefix-style — they must
+        trip the unusable filter exactly like the *_bf16-suffixed ones."""
+        lines = flip.recommend(
+            _tpu(**self._bf16(fwd_bf16_recompiles=1))
+        )
+        (ln,) = [x for x in lines if x.startswith("bf16:")]
+        assert "INVARIANT VIOLATED" in ln and "do NOT flip" in ln
+
+    def test_errored_bf16_window_blocks_flip(self):
+        lines = flip.recommend(
+            _tpu(**self._bf16(serve_errors_bf16=2))
+        )
+        (ln,) = [x for x in lines if x.startswith("bf16:")]
+        assert "ERRORED" in ln
+
+    def test_missing_forward_row_still_flags_dirty_subrows(self):
+        """bench's bf16 sub-rows are independently guarded: a record
+        with val/serve bf16 rows but no forward row must still surface
+        dirty counters (and otherwise say the forward row is missing),
+        never stay silent."""
+        lines = flip.recommend(
+            _tpu(val_pairs_per_sec_bf16=3.0, val_loop_recompiles_bf16=2)
+        )
+        (ln,) = [x for x in lines if x.startswith("bf16:")]
+        assert "INVARIANT VIOLATED" in ln
+        lines = flip.recommend(_tpu(val_pairs_per_sec_bf16=3.0,
+                                    val_loop_recompiles_bf16=0))
+        (ln,) = [x for x in lines if x.startswith("bf16:")]
+        assert "forward row missing" in ln

@@ -468,6 +468,88 @@ def test_jgl007_out_of_scope_paths_exempt(tmp_path):
     assert findings == []
 
 
+# --------------------------------------------------------------- JGL009
+
+
+def test_jgl009_flags_inline_dtype_literals_on_hot_path(tmp_path):
+    """Raw jnp dtype literals in models//nn//inference/ function bodies
+    bypass the precision policy — both the narrow (bfloat16) and the
+    wide (float32) direction are dtype decisions the policy must own."""
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def forward(policy, x, coords):
+            feats = x.astype(jnp.bfloat16)       # inline narrow
+            coords = coords.astype(jnp.float32)  # inline wide
+            acc = jnp.zeros((2,), jnp.float32)   # inline wide
+            return feats, coords, acc
+        """,
+        name="models/hotpath.py",
+    )
+    assert [f.rule for f in findings] == ["JGL009"] * 3
+    assert {f.qualname for f in findings} == {"forward"}
+
+
+def test_jgl009_sanctioned_routings_are_clean(tmp_path):
+    """The three sanctioned shapes: policy reads, flax class-attribute
+    defaults, and named module-level constants — plus out-of-scope paths
+    (ops/ keeps JGL005's narrower dtype-hygiene rule)."""
+    assert (
+        lint_snippet(
+            tmp_path,
+            """
+            import jax.numpy as jnp
+            from typing import Any
+
+            PARAM_DTYPE = jnp.float32  # mirrors PrecisionPolicy.param_jnp
+
+            class Conv:
+                dtype: Any = jnp.float32  # policy-settable knob
+
+                def __call__(self, policy, x):
+                    y = x.astype(self.dtype or x.dtype)
+                    return y.astype(policy.compute_jnp), PARAM_DTYPE
+            """,
+            name="nn/clean.py",
+        )
+        == []
+    )
+    assert (
+        lint_snippet(
+            tmp_path,
+            """
+            import jax.numpy as jnp
+
+            def widen(x):
+                return x.astype(jnp.float32)
+            """,
+            name="ops/free.py",
+            select=["JGL009"],
+        )
+        == []
+    )
+
+
+def test_jgl009_sentinel_module_in_scope(tmp_path):
+    """resilience/anomaly.py is scoped in deliberately: the sentinel's
+    f32 arithmetic is policy-pinned, so its literals must be VISIBLE
+    (allowlisted with justification), not invisible."""
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def guard(x):
+            return jnp.float32(0.5) * x
+        """,
+        name="resilience/anomaly.py",
+        select=["JGL009"],
+    )
+    assert [f.rule for f in findings] == ["JGL009"]
+
+
 # ------------------------------------------------------------- allowlist
 
 
