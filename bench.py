@@ -485,6 +485,34 @@ def _child_main() -> None:
             except Exception as e:  # never lose the earlier rows
                 print(f"bf16 train bench failed: {e}", file=sys.stderr)
 
+    # 1080p spatially-sharded row (docs/SHARDING.md; ROADMAP item 4):
+    # the flagship onthefly forward at 1088x1920, SPMD over the visible
+    # mesh whenever it has >1 device, with the collective-bytes sharding
+    # fingerprint and the standard guard counters. Last in line (it uses
+    # leftover budget — a 1080p compile + reps must never starve the
+    # established rows); reduced iters on CPU; BENCH_SKIP_HIGHRES=1
+    # turns it off explicitly, BENCH_MESH="data,spatial" pins the mesh.
+    if os.environ.get("BENCH_SKIP_HIGHRES") == "1":
+        pass
+    elif child_budget - (time.monotonic() - t0) > 0.12 * child_budget:
+        try:
+            record.update(_measure_highres(variables))
+            _emit(record)
+        except Exception as e:  # never lose the earlier rows
+            print(f"highres bench failed: {e}", file=sys.stderr)
+        # bf16 composition (ROADMAP item 3's folded follow-up): the same
+        # sharded window under the bf16_infer preset.
+        if (
+            os.environ.get("BENCH_SKIP_BF16") != "1"
+            and child_budget - (time.monotonic() - t0) > 0.12 * child_budget
+        ):
+            try:
+                rows = _measure_highres(variables, precision="bf16_infer")
+                record.update({f"{k}_bf16": v for k, v in rows.items()})
+                _emit(record)
+            except Exception as e:  # never lose the earlier rows
+                print(f"bf16 highres bench failed: {e}", file=sys.stderr)
+
 
 def _measure_bf16_forward(
     shape: dict, corr_impl: str, f32_forward, variables: dict,
@@ -892,6 +920,47 @@ def _measure_val_loop(
     }
 
 
+def _parse_mesh_env() -> tuple | None:
+    """The ONE parser for the ``BENCH_MESH`` "data,spatial" spec (set by
+    ``--mesh``): validated positive int pair or None, bad specs loudly
+    ignored. Every mesh-aware row goes through this — three hand-rolled
+    parsers would mean three divergent failure modes."""
+    spec = os.environ.get("BENCH_MESH")
+    if not spec:
+        return None
+    try:
+        data, spatial = (int(x) for x in spec.split(","))
+    except ValueError:
+        print(f"ignoring bad BENCH_MESH {spec!r} (want DATA,SPATIAL)",
+              file=sys.stderr)
+        return None
+    if data < 1 or spatial < 1:
+        print(f"ignoring bad BENCH_MESH {spec!r} (sizes must be >= 1)",
+              file=sys.stderr)
+        return None
+    return (data, spatial)
+
+
+def _bench_mesh_spec(batch_sizes: tuple) -> tuple | None:
+    """The (data, spatial) mesh the serving/streaming rows run under
+    when ``BENCH_MESH`` pins one (None otherwise). The rows' batch
+    programs shard their batch axis over `data`, so a data size their
+    batch sizes cannot divide is refused loudly rather than passed on
+    to fail mid-warmup."""
+    spec = _parse_mesh_env()
+    if spec is None or spec == (1, 1):
+        return None
+    data, spatial = spec
+    if any(b % data for b in batch_sizes):
+        print(
+            f"BENCH_MESH {spec}: data={data} does not divide batch "
+            f"sizes {batch_sizes}; running this row unsharded",
+            file=sys.stderr,
+        )
+        return None
+    return spec
+
+
 def _measure_serve(
     shape: dict, mixed_precision: bool, corr_impl: str, variables: dict,
     n_requests: int | None = None, precision: str = "f32",
@@ -950,6 +1019,7 @@ def _measure_serve(
         iter_levels=levels,
         recover_patience=2,
         precision=precision,
+        mesh=_bench_mesh_spec(batch_sizes=(1, 2)),
     )
     model = get_model(
         flagship_config(
@@ -1004,6 +1074,7 @@ def _measure_serve(
         "serve_timeouts": sstats.timeouts,
         "serve_errors": sstats.errors,
         "serve_budget_drops": server.budget.drops,
+        "serve_mesh": server.report()["mesh"],
         "serve_recompiles": wd.count,
         "serve_host_transfers": stats.host_transfers,
     }
@@ -1065,6 +1136,7 @@ def _measure_stream(
         batch_sizes=(1, 2, 4),
         queue_capacity=max(8, n_streams * frames),
         precision=precision,
+        mesh=_bench_mesh_spec(batch_sizes=(1, 2, 4)),
     )
     model = get_model(
         flagship_config(
@@ -1126,9 +1198,180 @@ def _measure_stream(
         "stream_occupancy_mean": report["mean_occupancy"],
         "stream_occupancy_peak": report["peak_occupancy"],
         "stream_capacity": n_streams,
+        "stream_mesh": report["mesh"],
         "stream_recompiles": wd.count,
         "stream_host_transfers": stats.host_transfers,
     }
+
+
+def _measure_highres(variables: dict, precision: str = "f32") -> dict:
+    """Guarded 1080p-class throughput row, spatially sharded whenever
+    the visible mesh has >1 device (docs/SHARDING.md; ROADMAP item 4).
+
+    The workload is the flagship onthefly-corr test-mode forward at
+    1088x1920 — the camera-resolution configuration whose O(HW) lookup
+    working set spatial sharding exists to split. Iteration count is
+    honest per platform: 32 (the Sintel eval setting) on an
+    accelerator, reduced (env ``BENCH_HIGHRES_ITERS``, default 2) on
+    CPU where a 32-iter 1080p forward runs for minutes.
+
+    Mesh: env ``BENCH_MESH`` ("data,spatial", set by ``--mesh``) wins;
+    otherwise (1, n_devices) with the spatial size walked down until it
+    divides the 1/8-res feature height. One device = unsharded — the
+    row still records, clearly fingerprinted ``nomesh``.
+
+    Sharding provenance: ``highres_mesh`` / ``highres_devices`` plus
+    the ``collective_stats`` fingerprint of the compiled program
+    (``highres_collectives`` / ``highres_collective_bytes`` — 0/0 when
+    unsharded, the partitioner's halo exchanges + fmap2 all-gathers
+    otherwise), and ``highres_analysis_temp_gib`` is the PER-DEVICE
+    compile-time footprint, which should drop roughly with the shard
+    count vs the unsharded comparison window.
+
+    Guards: the timed reps run under ``RecompileWatchdog`` +
+    ``forbid_host_transfers`` — ``highres_recompiles`` /
+    ``highres_host_transfers`` must be 0 (the per-rep sync is one
+    sanctioned ``jax.device_get`` of a scalar). When sharded, an
+    unsharded comparison window (same iters/reps; skip with
+    ``BENCH_HIGHRES_COMPARE=0``) records
+    ``highres_pairs_per_sec_unsharded`` so
+    ``flip_recommendations`` can judge the mesh default from data; its
+    guard counters fold into the same two fields (a leak in either
+    window invalidates the comparison).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_ncup_tpu.analysis.guards import (
+        GuardStats,
+        RecompileWatchdog,
+        forbid_host_transfers,
+    )
+    from raft_ncup_tpu.config import flagship_config
+    from raft_ncup_tpu.models.raft import get_model
+    from raft_ncup_tpu.parallel.mesh import (
+        collective_stats,
+        make_mesh,
+        mesh_fingerprint,
+    )
+    from raft_ncup_tpu.parallel.step import make_eval_step
+
+    platform = jax.devices()[0].platform
+    H, W = (
+        int(x)
+        for x in os.environ.get("BENCH_HIGHRES_SIZE", "1088,1920").split(",")
+    )
+    iters = int(
+        os.environ.get(
+            "BENCH_HIGHRES_ITERS", "32" if platform != "cpu" else "2"
+        )
+    )
+    reps = int(
+        os.environ.get(
+            "BENCH_HIGHRES_REPS", "3" if platform != "cpu" else "2"
+        )
+    )
+    strict = os.environ.get("BENCH_STRICT_GUARDS") == "1"
+
+    devices = jax.devices()
+    spec = _parse_mesh_env()
+    if spec is not None and (1 % spec[0] or (H // 8) % spec[1]):
+        # The workload is batch 1 at this H: a data axis > 1 or a
+        # spatial size that does not divide H//8 cannot shard it —
+        # fall back to the auto mesh rather than silently losing the
+        # row to a jit sharding error.
+        print(
+            f"BENCH_MESH {spec}: incompatible with the 1x{H}x{W} "
+            f"highres workload (batch 1, H//8 = {H // 8}); using the "
+            "auto-derived mesh instead",
+            file=sys.stderr,
+        )
+        spec = None
+    if spec is not None:
+        data, spatial = spec
+    else:
+        data, spatial = 1, len(devices)
+        while spatial > 1 and (H // 8) % spatial:
+            spatial -= 1
+    n_dev = data * spatial
+    mesh = (
+        make_mesh(data=data, spatial=spatial, devices=devices[:n_dev])
+        if n_dev > 1
+        else None
+    )
+
+    model = get_model(
+        flagship_config(
+            dataset="sintel", corr_impl="onthefly", precision=precision
+        )
+    )
+
+    def window(mesh_):
+        step = make_eval_step(model, iters=iters, mesh=mesh_)
+        img = jax.ShapeDtypeStruct((1, H, W, 3), jnp.float32)
+        t0 = time.perf_counter()
+        compiled = step.lower(variables, img, img).compile()
+        compile_s = time.perf_counter() - t0
+        mem = compiled.memory_analysis()
+        try:
+            coll = collective_stats(compiled.as_text())
+        except Exception as e:  # pragma: no cover - backend-specific
+            print(f"collective_stats unavailable: {e}", file=sys.stderr)
+            coll = {"collectives": None, "collective_bytes": None}
+        rng = np.random.default_rng(7)
+        img1 = jnp.asarray(rng.uniform(0, 255, (1, H, W, 3)), jnp.float32)
+        img2 = jnp.asarray(rng.uniform(0, 255, (1, H, W, 3)), jnp.float32)
+        # Warm rep outside the guards: also compiles the tiny scalar-
+        # slice sync program so the timed window sees zero compiles.
+        out = compiled(variables, img1, img2)
+        jax.device_get(out[1][0, 0, 0, 0])
+        stats = GuardStats()
+        rep_s = []
+        with RecompileWatchdog() as wd, forbid_host_transfers(
+            stats, raise_on_violation=strict
+        ):
+            for _ in range(max(1, reps)):
+                t0 = time.perf_counter()
+                out = compiled(variables, img1, img2)
+                # The honest sync (axon's block_until_ready returns
+                # early) via the one sanctioned explicit device_get.
+                jax.device_get(out[1][0, 0, 0, 0])
+                rep_s.append(time.perf_counter() - t0)
+        rep_s.sort()
+        median = rep_s[len(rep_s) // 2]
+        return {
+            "pairs_per_sec": round(1.0 / median, 4) if median else 0.0,
+            "rep_ms": [round(t * 1e3, 1) for t in rep_s],
+            "compile_s": round(compile_s, 1),
+            "temp_gib": round(int(mem.temp_size_in_bytes) / 2**30, 3),
+            "recompiles": wd.count,
+            "host_transfers": stats.host_transfers,
+            **coll,
+        }
+
+    main_w = window(mesh)
+    row = {
+        "highres_pairs_per_sec": main_w["pairs_per_sec"],
+        "highres_rep_ms": main_w["rep_ms"],
+        "highres_shape": f"1x{H}x{W}",
+        "highres_iters": iters,
+        "highres_compile_s": main_w["compile_s"],
+        "highres_mesh": mesh_fingerprint(mesh),
+        "highres_devices": n_dev,
+        "highres_analysis_temp_gib": main_w["temp_gib"],
+        "highres_collectives": main_w["collectives"],
+        "highres_collective_bytes": main_w["collective_bytes"],
+        "highres_recompiles": main_w["recompiles"],
+        "highres_host_transfers": main_w["host_transfers"],
+    }
+    if mesh is not None and os.environ.get("BENCH_HIGHRES_COMPARE") != "0":
+        ref = window(None)
+        row["highres_pairs_per_sec_unsharded"] = ref["pairs_per_sec"]
+        row["highres_analysis_temp_gib_unsharded"] = ref["temp_gib"]
+        row["highres_recompiles"] += ref["recompiles"]
+        row["highres_host_transfers"] += ref["host_transfers"]
+    return row
 
 
 def _measure_checkpoint(handles: dict) -> dict:
@@ -1307,9 +1550,24 @@ def main() -> None:
 
     ap = argparse.ArgumentParser(add_help=False)
     ap.add_argument("--trace_dir", default=None)
+    # --mesh DATA,SPATIAL (docs/SHARDING.md): pins the mesh the highres
+    # row (and any mesh-aware row) runs on. Children inherit it via env
+    # BENCH_MESH; on the CPU fallback the product also forces that many
+    # virtual host devices so the sharded program can actually execute.
+    ap.add_argument("--mesh", default=os.environ.get("BENCH_MESH"))
     cli_args, _ = ap.parse_known_args()
     if cli_args.trace_dir:
         os.environ["BENCH_TRACE_DIR"] = os.path.abspath(cli_args.trace_dir)
+    mesh_devices = 0
+    if cli_args.mesh:
+        os.environ["BENCH_MESH"] = cli_args.mesh
+        spec = _parse_mesh_env()
+        if spec is None:
+            # A spec the parser rejects must not reach the children
+            # either — they would each re-reject it, or worse.
+            os.environ.pop("BENCH_MESH", None)
+        else:
+            mesh_devices = spec[0] * spec[1]
 
     t0 = time.monotonic()
 
@@ -1385,6 +1643,13 @@ def main() -> None:
     #    partially-warm cache is what makes the retry viable.
     if not result:
         cpu_env = {"JAX_PLATFORMS": "cpu", "_BENCH_FORCE_PLATFORM": "cpu"}
+        if mesh_devices > 1:
+            # A pinned multi-device mesh on the CPU fallback needs that
+            # many virtual host devices before the child's jax init.
+            cpu_env["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={mesh_devices}"
+            ).strip()
         result, crashed = _run_child(
             cpu_env, SMALL, max(60.0, min(CPU_RESERVE_S, remaining() - 10))
         )

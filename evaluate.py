@@ -67,8 +67,12 @@ def main(argv=None) -> None:
         print(f"exported reference-keyed checkpoint to {args.export_pth}")
         return
 
-    mesh = None
-    if args.spatial_parallel > 1:
+    # --mesh DATA,SPATIAL is the first-class surface (docs/SHARDING.md);
+    # --spatial_parallel N stays as reference-era shorthand for 1,N.
+    from raft_ncup_tpu.cli import mesh_from_args
+
+    mesh = mesh_from_args(args)
+    if mesh is None and args.spatial_parallel > 1:
         from raft_ncup_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh(data=1, spatial=args.spatial_parallel)

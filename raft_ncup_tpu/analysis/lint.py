@@ -151,27 +151,54 @@ def discover_declared_axes(trees: dict) -> frozenset:
     ``axis_names=``). parallel/mesh.py is the only production declarer."""
     axes: set = set()
     for tree, aliases in trees.values():
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            dn = dotted_name(node.func, aliases)
-            if dn is None or dn.split(".")[-1] != "Mesh":
-                continue
-            cand = None
-            if len(node.args) >= 2:
-                cand = node.args[1]
-            for kw in node.keywords:
-                if kw.arg == "axis_names":
-                    cand = kw.value
-            elts = (
-                cand.elts
-                if isinstance(cand, (ast.Tuple, ast.List))
-                else [cand]
-            )
-            for e in elts:
-                if isinstance(e, ast.Constant) and isinstance(e.value, str):
-                    axes.add(e.value)
+        axes |= _axes_in_tree(tree, aliases)
     return frozenset(axes)
+
+
+def production_declared_axes() -> frozenset:
+    """Axis names declared by the package's production mesh declarer
+    (``parallel/mesh.py``), parsed directly so JGL006 has a judgment
+    baseline even when the linted set does not include it — e.g.
+    linting ``inference/``, ``serving/``, or ``streaming/`` standalone.
+    Before this fallback those runs had no declaration in scope, the
+    rule stayed silent, and a typo'd PartitionSpec axis in a serving
+    module would silently replicate (the exact hazard JGL006 exists
+    for). Returns the empty set when the file is missing/unparseable
+    (vendored partial checkouts): silence, never a crash."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(__file__)), "parallel", "mesh.py"
+    )
+    try:
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+    except (OSError, SyntaxError):
+        return frozenset()
+    return frozenset(_axes_in_tree(tree, collect_aliases(tree)))
+
+
+def _axes_in_tree(tree, aliases) -> set:
+    axes: set = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dn = dotted_name(node.func, aliases)
+        if dn is None or dn.split(".")[-1] != "Mesh":
+            continue
+        cand = None
+        if len(node.args) >= 2:
+            cand = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "axis_names":
+                cand = kw.value
+        elts = (
+            cand.elts
+            if isinstance(cand, (ast.Tuple, ast.List))
+            else [cand]
+        )
+        for e in elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                axes.add(e.value)
+    return axes
 
 
 def run_lint(
@@ -211,11 +238,16 @@ def run_lint(
             continue
         trees[display] = (tree, collect_aliases(tree))
     result.files_checked = len(trees)
-    result.declared_axes = (
-        declared_axes
-        if declared_axes is not None
-        else discover_declared_axes(trees)
-    )
+    if declared_axes is not None:
+        result.declared_axes = declared_axes
+    else:
+        result.declared_axes = discover_declared_axes(trees)
+        if not result.declared_axes:
+            # No Mesh declaration in the linted set (standalone lint of
+            # inference//serving//streaming/): fall back to the
+            # production declarer so PartitionSpec axes there are still
+            # judged instead of silently skipped.
+            result.declared_axes = production_declared_axes()
 
     # Pass 2: rules.
     from raft_ncup_tpu.analysis.astutil import TracedIndex

@@ -9,8 +9,14 @@ misspelled axis OOMs a chip instead of erroring).
 
 Declared axes are discovered from the lint run itself: any linted module
 constructing ``jax.sharding.Mesh`` with literal axis names contributes
-its names (engine-side; see ``lint.discover_declared_axes``). When no
-declaration is in scope the rule stays silent rather than guessing.
+its names (engine-side; see ``lint.discover_declared_axes``). When the
+linted set declares nothing, the engine falls back to the production
+declarer ``parallel/mesh.py`` (``lint.production_declared_axes``) —
+standalone lints of ``inference/``, ``serving/``, or ``streaming/``
+must still judge new PartitionSpecs against the real mesh axes, not go
+silent. Only when no declaration exists anywhere (callers passing an
+explicit empty ``declared_axes``, partial checkouts without mesh.py)
+does the rule stay silent rather than guess.
 """
 
 from __future__ import annotations

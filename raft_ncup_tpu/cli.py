@@ -173,6 +173,38 @@ def str2ints(v: str) -> tuple[int, ...]:
         raise argparse.ArgumentTypeError(f"comma-joined ints expected: {v!r}")
 
 
+def str2mesh(v: str) -> tuple[int, int]:
+    """Parse the ``--mesh DATA,SPATIAL`` device-mesh spec."""
+    out = str2ints(v)
+    if len(out) != 2 or any(x < 1 for x in out):
+        raise argparse.ArgumentTypeError(
+            f"mesh spec must be DATA,SPATIAL positive sizes: {v!r}"
+        )
+    return out
+
+
+def add_mesh_arg(parser: argparse.ArgumentParser) -> None:
+    """The (data x spatial) SPMD mesh flag shared by evaluate.py,
+    serve.py, and bench.py (docs/SHARDING.md)."""
+    parser.add_argument(
+        "--mesh", type=str2mesh, default=None, metavar="DATA,SPATIAL",
+        help="run the inference/serving stack spatially sharded on a "
+        "(data x spatial) device mesh, e.g. '1,2' (docs/SHARDING.md). "
+        "Batches shard over data, image height over spatial; pads round "
+        "up to 8*spatial. Default: unsharded.",
+    )
+
+
+def mesh_from_args(args: argparse.Namespace):
+    """Build the jax Mesh named by ``--mesh`` (None when unset)."""
+    spec = getattr(args, "mesh", None)
+    if not spec:
+        return None
+    from raft_ncup_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(data=spec[0], spatial=spec[1])
+
+
 def add_serve_args(parser: argparse.ArgumentParser) -> None:
     """Serving-tier knobs (ServeConfig; raft_ncup_tpu/serving/,
     docs/SERVING.md)."""
@@ -232,6 +264,7 @@ def serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
         pad_bucket=args.serve_pad_bucket,
         cache_size=args.serve_cache_size,
         precision=args.serve_precision,
+        mesh=getattr(args, "mesh", None),
     )
 
 
@@ -296,6 +329,7 @@ def stream_config_from_args(
         carry_net=args.carry_net,
         anomaly_max_flow=args.anomaly_max_flow,
         precision=args.stream_precision,
+        mesh=getattr(args, "mesh", None),
     )
 
 
@@ -509,7 +543,8 @@ def build_eval_parser() -> argparse.ArgumentParser:
     parser.add_argument("--spatial_parallel", type=int, default=1,
                         help="shard eval height over this many devices "
                         "(high-res inference; pairs with --corr_impl "
-                        "onthefly)")
+                        "onthefly). Shorthand for --mesh 1,N")
+    add_mesh_arg(parser)
     parser.add_argument("--iters", type=int, default=None,
                         help="GRU iteration override; default keeps each "
                         "validator's reference setting (sintel 32, "

@@ -258,6 +258,36 @@ class DataConfig:
     synthetic_style: str = "smooth"
 
 
+def _check_mesh_field(mesh, batch_sizes: tuple, pad_bucket: int = 0) -> None:
+    """Shared (data, spatial) mesh-field validation for the serving and
+    streaming configs: jit's in_shardings require every allowed batch
+    size to divide the `data` axis, and under a mesh every pad rounds
+    to 8*spatial, so an explicit ``pad_bucket`` must be a multiple of
+    that divisor (InputPadder rejects the combination per call — a
+    violation must be a clear error at config time, not an exception
+    escaping FlowServer.submit() past the terminal-status contract)."""
+    if mesh is None:
+        return
+    m = tuple(int(x) for x in mesh)
+    if len(m) != 2 or any(x < 1 for x in m):
+        raise ValueError(
+            f"mesh must be (data, spatial) positive sizes: {mesh!r}"
+        )
+    data, spatial = m
+    bad = [b for b in batch_sizes if b % data]
+    if bad:
+        raise ValueError(
+            f"batch sizes {bad} are not divisible by mesh data={data}; "
+            "every allowed batch program shards its batch axis over the "
+            "data mesh axis"
+        )
+    if pad_bucket and pad_bucket % (8 * spatial):
+        raise ValueError(
+            f"pad_bucket {pad_bucket} must be a multiple of the mesh "
+            f"pad divisor 8*spatial = {8 * spatial}"
+        )
+
+
 @dataclass(frozen=True)
 class ServeConfig:
     """Online flow-serving knobs (raft_ncup_tpu/serving/; docs/SERVING.md).
@@ -316,6 +346,13 @@ class ServeConfig:
     # None (default) inherits the model's own policy — a server wrapped
     # around a bf16-configured model serves bf16 unless told otherwise.
     precision: str | None = None
+    # (data, spatial) device-mesh sizes (docs/SHARDING.md): the server's
+    # whole executable set compiles as SPMD programs over this mesh —
+    # request batches shard over `data`, image height over `spatial`
+    # (pads round up to 8*spatial so the 1/8-res feature height divides
+    # the spatial axis). The mesh fingerprint rides every compiled-
+    # program key. None (default) = unsharded single-device serving.
+    mesh: tuple[int, int] | None = None
 
     def __post_init__(self) -> None:
         if self.precision is not None:
@@ -327,6 +364,7 @@ class ServeConfig:
             raise ValueError(
                 f"batch_sizes must be ascending unique positives: {bs!r}"
             )
+        _check_mesh_field(self.mesh, bs, self.pad_bucket)
         lv = tuple(int(x) for x in self.iter_levels)
         if not lv or any(x <= 0 for x in lv) or list(lv) != sorted(
             lv, reverse=True
@@ -407,6 +445,12 @@ class StreamConfig:
     # policy's pinned f32 coord dtype in-graph. None (default) inherits
     # the model's own policy.
     precision: str | None = None
+    # (data, spatial) device-mesh sizes (docs/SHARDING.md): the step
+    # programs compile as SPMD over this mesh — frame batches shard over
+    # `data`, frame height over `spatial`, and the slot table shards
+    # over `data` when (capacity + 1) divides it (else it replicates).
+    # Frames pad to 8*spatial. None (default) = unsharded.
+    mesh: tuple[int, int] | None = None
 
     def __post_init__(self) -> None:
         if self.precision is not None:
@@ -418,6 +462,7 @@ class StreamConfig:
             raise ValueError(
                 f"batch_sizes must be ascending unique positives: {bs!r}"
             )
+        _check_mesh_field(self.mesh, bs, self.pad_bucket)
         if self.capacity < 1:
             raise ValueError(f"capacity must be >= 1: {self.capacity}")
         if self.iters < 1:

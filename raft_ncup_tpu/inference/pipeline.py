@@ -332,9 +332,9 @@ class AsyncDrain:
 
 
 class ShapeCachedForward:
-    """Bounded LRU of compiled test-mode executables, keyed by (padded
-    shape, iters, warm-start presence, metric kind/pad, precision-policy
-    fingerprint).
+    """Bounded LRU of compiled test-mode executables, keyed by (mesh
+    fingerprint, padded shape, iters, warm-start presence, metric
+    kind/pad, precision-policy fingerprint).
 
     Frames stream with dataset-dependent sizes, so each unique padded
     shape compiles once; the LRU bound (default 8, knob:
@@ -363,9 +363,17 @@ class ShapeCachedForward:
         self, model, variables: dict, mesh=None, cache_size: int = 8,
         policy=None,
     ):
+        from raft_ncup_tpu.parallel.mesh import mesh_fingerprint
+
         self.model = model
         self.variables = variables
         self.mesh = mesh
+        # Part of EVERY cache key (see _get): a sharded and an unsharded
+        # program for the same shape/iters/policy are different
+        # executables, and the fingerprint keeps that distinction even
+        # for caches that outlive a mesh reconfiguration (or custom()
+        # keys minted by subsystems that never look at self.mesh).
+        self.mesh_fp = mesh_fingerprint(mesh)
         # apply()-compatible stand-ins (tests' dummy models) carry no
         # policy; they resolve to the f32 default and are never swapped.
         self.policy = (
@@ -418,6 +426,9 @@ class ShapeCachedForward:
         )
 
     def _get(self, key, build):
+        # Single chokepoint for key construction: every compiled-program
+        # key — forward, metric, custom — carries the mesh fingerprint.
+        key = (self.mesh_fp,) + tuple(key)
         fn = self._fns.get(key)
         if fn is not None:
             self._fns.move_to_end(key)

@@ -1,6 +1,7 @@
 from raft_ncup_tpu.parallel.mesh import (  # noqa: F401
     batch_sharding,
     make_mesh,
+    mesh_fingerprint,
     replicated,
 )
 from raft_ncup_tpu.parallel.multihost import (  # noqa: F401

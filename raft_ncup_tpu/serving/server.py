@@ -100,6 +100,15 @@ class FlowServer:
         self.cfg = cfg or ServeConfig()
         self._clock = clock
         self.stats = ServeStats()
+        # Mesh-first serving (docs/SHARDING.md): an explicit `mesh=`
+        # wins; otherwise ServeConfig.mesh = (data, spatial) builds one.
+        # Every compiled serving program is then a single SPMD program —
+        # batches sharded over `data`, image height over `spatial` — and
+        # request pads round up to the mesh divisor.
+        from raft_ncup_tpu.parallel.mesh import resolve_config_mesh
+
+        mesh, self._pad_divisor = resolve_config_mesh(mesh, self.cfg.mesh)
+        self.mesh = mesh
         # The per-ServeConfig precision policy (docs/PRECISION.md): every
         # compiled serving program — warmup set included — runs under it,
         # and its fingerprint rides every executable key. None inherits
@@ -176,6 +185,7 @@ class FlowServer:
             return handle
         h, w = int(image1.shape[0]), int(image1.shape[1])
         padder = InputPadder((h, w, 3), mode="sintel",
+                             divisor=self._pad_divisor,
                              bucket=self.cfg.pad_bucket)
         (t, b), (le, r) = padder.pad_spec
         deadline_s = (
@@ -383,6 +393,7 @@ class FlowServer:
 
         h, w = size_hw
         padder = InputPadder((int(h), int(w), 3), mode="sintel",
+                             divisor=self._pad_divisor,
                              bucket=self.cfg.pad_bucket)
         (t, b), (le, r) = padder.pad_spec
         ph, pw = int(h) + t + b, int(w) + le + r
@@ -446,6 +457,7 @@ class FlowServer:
             "budget_recoveries": self.budget.recoveries,
             "executables": dict(self._fwd.stats),
             "precision": self._fwd.policy.name,  # RESOLVED (None inherits)
+            "mesh": self._fwd.mesh_fp,
         }
 
     def __enter__(self) -> "FlowServer":

@@ -149,14 +149,26 @@ class StreamEngine:
         variables: dict,
         cfg: Optional[StreamConfig] = None,
         *,
+        mesh=None,
         clock: Callable[[], float] = time.monotonic,
     ):
         self.cfg = cfg or StreamConfig()
         self._clock = clock
         self.stats = StreamStats()
+        # Mesh-first streaming (docs/SHARDING.md): an explicit `mesh=`
+        # wins; otherwise StreamConfig.mesh = (data, spatial) builds
+        # one. The step programs then compile as SPMD — frame batches
+        # sharded over `data`, frame height over `spatial`, the slot
+        # table over `data` (when capacity+1 divides it) — and frames
+        # pad to the mesh divisor.
+        from raft_ncup_tpu.parallel.mesh import resolve_config_mesh
+
+        mesh, self._pad_divisor = resolve_config_mesh(mesh, self.cfg.mesh)
+        self.mesh = mesh
         h, w = self.cfg.frame_hw
         padder = InputPadder(
-            (int(h), int(w), 3), mode="sintel", bucket=self.cfg.pad_bucket
+            (int(h), int(w), 3), mode="sintel",
+            divisor=self._pad_divisor, bucket=self.cfg.pad_bucket,
         )
         (t, b), (le, r) = padder.pad_spec
         self._ph, self._pw = int(h) + t + b, int(w) + le + r
@@ -190,7 +202,7 @@ class StreamEngine:
         # would be a use-after-donate.
         self._table_lock = threading.Lock()
         self._fwd = ShapeCachedForward(
-            model, variables, cache_size=self.cfg.cache_size,
+            model, variables, mesh=mesh, cache_size=self.cfg.cache_size,
             policy=self._policy,
         )
         self._queue = AdmissionQueue(self.cfg.queue_capacity)
@@ -363,7 +375,8 @@ class StreamEngine:
             return f"non-numeric dtype {dtype}"
         h, w = int(shape[0]), int(shape[1])
         padder = InputPadder(
-            (h, w, 3), mode="sintel", bucket=self.cfg.pad_bucket
+            (h, w, 3), mode="sintel", divisor=self._pad_divisor,
+            bucket=self.cfg.pad_bucket,
         )
         (t, b), (le, r) = padder.pad_spec
         if (h + t + b, w + le + r) != (self._ph, self._pw):
@@ -377,7 +390,8 @@ class StreamEngine:
     def _pad_spec_for(self, native_hw: Tuple[int, int]) -> tuple:
         h, w = native_hw
         return InputPadder(
-            (h, w, 3), mode="sintel", bucket=self.cfg.pad_bucket
+            (h, w, 3), mode="sintel", divisor=self._pad_divisor,
+            bucket=self.cfg.pad_bucket,
         ).pad_spec
 
     def _retry_after(self) -> float:
@@ -444,6 +458,7 @@ class StreamEngine:
             iters, thresh = cfg.iters, cfg.anomaly_max_flow
             carry_net = bool(self._hidden)
             state_dt = policy.state_jnp
+            mesh = self.mesh
 
             def fn(v, table, img1, img2, slot_idx, cold):
                 # Storage is (possibly) narrow; the warm-start splat is
@@ -470,7 +485,7 @@ class StreamEngine:
                     }
                 flow_lr, flow_up, net_f = model.apply(
                     v, img1, img2, iters=iters, flow_init=finit,
-                    test_mode=True, return_net=True, **kwargs,
+                    test_mode=True, return_net=True, mesh=mesh, **kwargs,
                 )
                 # In-graph anomaly: a non-finite or diverged row resets
                 # ITS slot to cold; batch-mates' rows are untouched.
@@ -502,7 +517,33 @@ class StreamEngine:
 
             # Donate the slot table: the step's scatter updates it in
             # place, so exactly one table lives in HBM.
-            return jax.jit(fn, donate_argnums=(1,))
+            if mesh is None:
+                return jax.jit(fn, donate_argnums=(1,))
+            # SPMD step (docs/SHARDING.md): one program over the whole
+            # mesh — frame batches shard over (data, spatial), the slot
+            # table over `data` when its capacity+1 rows divide the
+            # axis (else replicated: uneven NamedShardings are a jit
+            # error, and the table is small next to the activations).
+            # Donation still holds: in/out table shardings match.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(mesh, P())
+            img = NamedSharding(mesh, P("data", "spatial"))
+            n_data = int(mesh.shape.get("data", 1))
+            tab = (
+                NamedSharding(mesh, P("data"))
+                if (cfg.capacity + 1) % n_data == 0
+                else repl
+            )
+            table_sh = {"flow": tab, "warm": tab}
+            if carry_net:
+                table_sh["net"] = tab
+            return jax.jit(
+                fn,
+                in_shardings=(repl, table_sh, img, img, repl, repl),
+                out_shardings=(table_sh, repl, repl),
+                donate_argnums=(1,),
+            )
 
         return self._fwd.custom(
             ("stream", n_rows, policy.fingerprint()), build
@@ -734,6 +775,7 @@ class StreamEngine:
             "evicted": evicted,
             "executables": dict(self._fwd.stats),
             "precision": self._policy.name,  # RESOLVED (None inherits)
+            "mesh": self._fwd.mesh_fp,
         }
 
     def __enter__(self) -> "StreamEngine":

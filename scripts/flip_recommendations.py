@@ -36,7 +36,7 @@ def recommend(record: dict) -> list[str]:
             "corr_impl='volume', RAFT_NCUP_NCONV_IMPL='xla' pending TPU data"
         ] + _val_row_lines(record) + _serve_row_lines(record) + _bf16_row_lines(
             record
-        )
+        ) + _highres_row_lines(record)
 
     corr = {"volume": record.get("value")}
     for tag in ("onthefly", "pallas"):
@@ -101,6 +101,7 @@ def recommend(record: dict) -> list[str]:
     lines.extend(_val_row_lines(record))
     lines.extend(_serve_row_lines(record))
     lines.extend(_bf16_row_lines(record))
+    lines.extend(_highres_row_lines(record))
 
     nc = record.get("pairs_per_sec_nconv_pallas")
     fell_back = record.get("pairs_per_sec_nconv_pallas_FELL_BACK_TO_XLA")
@@ -269,6 +270,73 @@ def _bf16_row_lines(record: dict) -> list[str]:
         f"clean) on a CPU row ({bf16:.2f} vs {base or 0:.2f} pairs/s, "
         "bf16 emulated) — no flip from CPU data; rows are staged for "
         "first hardware contact"
+    ]
+
+
+def _highres_row_lines(record: dict) -> list[str]:
+    """Spatially-sharded 1080p row (bench.py ``highres_*`` fields;
+    docs/SHARDING.md) — the corr_impl flip discipline applied to the
+    serving/streaming mesh default: absent row → no lines (older
+    records predate it); nonzero guard counters → the numbers measured
+    a leaking/recompiling program and are unusable; a clean
+    multi-device window with a >= MARGIN win over its own
+    single-device comparison, on ACCELERATOR data → flip the
+    serve/stream default mesh (CPU emulates the mesh on virtual host
+    devices — its ordering says nothing about ICI collectives)."""
+    hr = record.get("highres_pairs_per_sec")
+    if hr is None:
+        return []
+    transfers = record.get("highres_host_transfers")
+    recompiles = record.get("highres_recompiles")
+    if transfers or recompiles:
+        return [
+            "highres: INVARIANT VIOLATED during the 1080p window(s) "
+            f"({transfers or 0} implicit host transfer(s), "
+            f"{recompiles or 0} recompile(s)) — the highres_* numbers "
+            "measure a leaking or recompiling program; fix the leak "
+            "(docs/ANALYSIS.md) before reading them or judging the mesh"
+        ]
+    devices = record.get("highres_devices") or 1
+    mesh = record.get("highres_mesh", "nomesh")
+    if devices <= 1:
+        return [
+            f"highres: single-device row ({hr:.3f} pairs/s at "
+            f"{record.get('highres_iters', '?')} iters, invariants "
+            "clean) — no mesh to judge; rerun with >1 visible device "
+            "(--mesh) for the sharded row"
+        ]
+    ref = record.get("highres_pairs_per_sec_unsharded")
+    if ref is None:
+        return [
+            f"highres: sharded row clean ({hr:.3f} pairs/s on {mesh}) "
+            "but no single-device comparison in the record "
+            "(BENCH_HIGHRES_COMPARE=0?); no mesh verdict without it"
+        ]
+    key = str(record.get("baseline_key", ""))
+    on_accel = bool(key) and not key.startswith("cpu")
+    if on_accel and ref and hr >= MARGIN * ref:
+        return [
+            f"highres: FLIP serve/stream default mesh — {mesh} measured "
+            f"{hr:.3f} vs {ref:.3f} pairs/s single-device at 1080p "
+            "(invariants clean; set ServeConfig.mesh / StreamConfig.mesh "
+            "in raft_ncup_tpu/config.py, or --mesh on serve.py)"
+        ]
+    if on_accel:
+        return [
+            f"highres: mesh {mesh} shows no >= {MARGIN:.2f}x win at "
+            f"1080p ({hr:.3f} vs {ref:.3f} pairs/s single-device); keep "
+            "the unsharded default — sharding still buys per-device "
+            f"memory ({record.get('highres_analysis_temp_gib', '?')} vs "
+            f"{record.get('highres_analysis_temp_gib_unsharded', '?')} "
+            "GiB temp)"
+        ]
+    return [
+        f"highres: sharded row clean on CPU-emulated {mesh} "
+        f"({hr:.3f} vs {ref:.3f} pairs/s single-device; per-device temp "
+        f"{record.get('highres_analysis_temp_gib', '?')} vs "
+        f"{record.get('highres_analysis_temp_gib_unsharded', '?')} GiB) "
+        "— no mesh flip from CPU data; the row is staged for first "
+        "hardware contact"
     ]
 
 

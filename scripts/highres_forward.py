@@ -1,7 +1,8 @@
 #!/usr/bin/env python
-"""Execute ONE real 1088x1920 / 32-iteration test-mode forward and report
-peak RSS + wall time — the out-of-band evidence behind docs/PERF.md's
-"1080p executed for real" row.
+"""Execute ONE real 1088x1920 / 32-iteration test-mode forward —
+optionally spatially sharded — and report peak RSS + wall time: the
+out-of-band evidence behind docs/PERF.md's "1080p executed for real"
+and "spatially sharded 1080p executed" rows.
 
 tests/test_highres.py pins the 1080p memory story with *compiler memory
 analysis* (platform-independent, cheap); this script is the complement:
@@ -12,13 +13,26 @@ argument + output footprint the analysis predicts (host arenas and the
 compiler itself add overhead on top, which is why both numbers are
 recorded side by side).
 
+``--spatial N`` (N > 1) runs the SAME forward as one SPMD program on a
+(1 data x N spatial) mesh. On a host with fewer than N real devices the
+CPU platform is split into N virtual devices
+(``--xla_force_host_platform_device_count``, the tests/conftest.py
+mechanism), so the report's ``analysis_*`` numbers become PER-DEVICE:
+they should drop roughly with the shard count, matching
+tests/test_highres.py's compile-time claim — now on an executed
+program. Note the CPU-emulation caveat (docs/SHARDING.md): all N
+virtual devices share one address space, so ``peak_rss_gib`` still
+aggregates every shard; per-device footprint is the ``analysis_*``
+fields. ``collectives``/``collective_bytes`` fingerprint the sharding
+(0/0 when unsharded).
+
 Usage:
     JAX_PLATFORMS=cpu python scripts/highres_forward.py [--iters 32]
-        [--size 1088 1920] [--corr_impl onthefly]
+        [--size 1088 1920] [--corr_impl onthefly] [--spatial 2]
 
-Prints one JSON line: shape, iters, compile_s, run_s (the executed
-forward, compile excluded), peak_rss_gib, memory-analysis bytes for the
-same executable.
+Prints one JSON line: shape, iters, mesh, compile_s, run_s (the
+executed forward, compile excluded), peak_rss_gib, per-device
+memory-analysis bytes and collective stats for the same executable.
 """
 
 from __future__ import annotations
@@ -42,7 +56,21 @@ def main(argv=None) -> int:
     p.add_argument("--iters", type=int, default=32)
     p.add_argument("--corr_impl", default="onthefly",
                    choices=["onthefly", "volume", "pallas"])
+    p.add_argument("--spatial", type=int, default=1,
+                   help="shard the image height over this many devices "
+                   "(1 = unsharded). On CPU, forces this many virtual "
+                   "host devices BEFORE jax initializes.")
     args = p.parse_args(argv)
+
+    if args.spatial > 1:
+        # Must land before the first jax import: device count is fixed
+        # at backend init. Harmless when real devices already exist.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.spatial}"
+            ).strip()
 
     import jax
     import jax.numpy as jnp
@@ -50,20 +78,41 @@ def main(argv=None) -> int:
 
     from raft_ncup_tpu.config import flagship_config
     from raft_ncup_tpu.models import get_model
+    from raft_ncup_tpu.parallel.mesh import (
+        collective_stats,
+        make_mesh,
+        mesh_fingerprint,
+    )
+    from raft_ncup_tpu.parallel.step import make_eval_step
 
     h, w = args.size
+    if (h // 8) % args.spatial:
+        raise SystemExit(
+            f"--spatial {args.spatial} must divide height/8 = {h // 8} "
+            "(pad with InputPadder(divisor=8*spatial) first)"
+        )
     cfg = flagship_config(dataset="sintel", corr_impl=args.corr_impl)
     model = get_model(cfg)
     variables = model.init(jax.random.PRNGKey(0), (1, 64, 64, 3))
 
-    def fwd(v, i1, i2):
-        return model.apply(v, i1, i2, iters=args.iters, test_mode=True)
+    mesh = (
+        make_mesh(data=1, spatial=args.spatial,
+                  devices=jax.devices()[: args.spatial])
+        if args.spatial > 1
+        else None
+    )
+    step = make_eval_step(model, iters=args.iters, mesh=mesh)
 
     img = jax.ShapeDtypeStruct((1, h, w, 3), jnp.float32)
     t0 = time.perf_counter()
-    compiled = jax.jit(fwd).lower(variables, img, img).compile()
+    compiled = step.lower(variables, img, img).compile()
     compile_s = time.perf_counter() - t0
     mem = compiled.memory_analysis()
+    try:
+        coll = collective_stats(compiled.as_text())
+    except Exception as e:  # pragma: no cover - backend-specific text
+        print(f"collective_stats unavailable: {e}", file=sys.stderr)
+        coll = {"collectives": None, "collective_bytes": None}
 
     rng = np.random.default_rng(0)
     img1 = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)), jnp.float32)
@@ -81,10 +130,14 @@ def main(argv=None) -> int:
         "iters": args.iters,
         "corr_impl": args.corr_impl,
         "platform": jax.default_backend(),
+        "mesh": mesh_fingerprint(mesh),
+        "devices": args.spatial,
         "compile_s": round(compile_s, 1),
         "run_s": round(run_s, 1),
         "finite": finite,
         "peak_rss_gib": round(peak_rss / 2**30, 2),
+        # memory_analysis of an SPMD executable is PER DEVICE: under
+        # --spatial N these should drop roughly with N.
         "analysis_temp_gib": round(
             int(mem.temp_size_in_bytes) / 2**30, 2
         ),
@@ -97,6 +150,7 @@ def main(argv=None) -> int:
             / 2**30,
             2,
         ),
+        **coll,
     }
     print(json.dumps(report), flush=True)
     return 0 if finite else 1

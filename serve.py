@@ -46,6 +46,7 @@ import time
 
 def build_parser() -> argparse.ArgumentParser:
     from raft_ncup_tpu.cli import (
+        add_mesh_arg,
         add_model_args,
         add_platform_arg,
         add_serve_args,
@@ -87,6 +88,7 @@ def build_parser() -> argparse.ArgumentParser:
                         help="[--stream] frames submitted per stream")
     add_serve_args(parser)
     add_stream_args(parser)
+    add_mesh_arg(parser)
     add_model_args(parser)
     add_platform_arg(parser)
     return parser

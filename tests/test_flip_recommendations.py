@@ -222,6 +222,84 @@ class TestServeRow:
         assert any("serve: steady state" in l for l in lines)
 
 
+class TestHighresRow:
+    """The spatially-sharded 1080p row verdicts (docs/SHARDING.md): the
+    corr_impl flip discipline applied to the serve/stream mesh default."""
+
+    @staticmethod
+    def _highres(**kw):
+        base = dict(
+            highres_pairs_per_sec=4.0,
+            highres_pairs_per_sec_unsharded=3.0,
+            highres_iters=32,
+            highres_mesh="mesh(data=1,spatial=2:tpu)",
+            highres_devices=2,
+            highres_analysis_temp_gib=0.65,
+            highres_analysis_temp_gib_unsharded=1.25,
+            highres_collectives=10,
+            highres_collective_bytes=123456,
+            highres_recompiles=0,
+            highres_host_transfers=0,
+        )
+        base.update(kw)
+        return base
+
+    def test_absent_highres_row_adds_no_lines(self):
+        lines = flip.recommend(_tpu())
+        assert not any("highres" in l for l in lines)
+
+    def test_violated_invariants_flag_row_unusable(self):
+        lines = flip.recommend(
+            _tpu(**self._highres(highres_recompiles=1,
+                                 highres_host_transfers=2))
+        )
+        joined = "\n".join(lines)
+        assert "highres: INVARIANT VIOLATED" in joined
+        assert "1 recompile(s)" in joined
+        assert "2 implicit host transfer(s)" in joined
+        assert "FLIP serve/stream" not in joined
+
+    def test_single_device_row_asks_for_a_mesh(self):
+        lines = flip.recommend(
+            _tpu(**self._highres(highres_devices=1,
+                                 highres_mesh="nomesh"))
+        )
+        assert any("no mesh to judge" in l for l in lines)
+
+    def test_missing_comparison_blocks_verdict(self):
+        rec = self._highres()
+        del rec["highres_pairs_per_sec_unsharded"]
+        lines = flip.recommend(_tpu(**rec))
+        joined = "\n".join(lines)
+        assert "no single-device comparison" in joined
+        assert "FLIP serve/stream" not in joined
+
+    def test_clean_accelerator_win_flips_mesh_default(self):
+        lines = flip.recommend(_tpu(**self._highres()))
+        joined = "\n".join(lines)
+        assert "highres: FLIP serve/stream default mesh" in joined
+        assert "4.000 vs 3.000 pairs/s" in joined
+
+    def test_accelerator_without_margin_keeps_unsharded(self):
+        lines = flip.recommend(
+            _tpu(**self._highres(highres_pairs_per_sec=3.01))
+        )
+        joined = "\n".join(lines)
+        assert "keep the unsharded default" in joined
+        assert "FLIP serve/stream" not in joined
+        assert "per-device memory" in joined
+
+    def test_cpu_row_never_flips_but_is_staged(self):
+        lines = flip.recommend(
+            {"value": 9.0, "baseline_key": "cpu@h:volume:x",
+             **self._highres(
+                 highres_mesh="mesh(data=1,spatial=2:cpu)")}
+        )
+        joined = "\n".join(lines)
+        assert "no mesh flip from CPU data" in joined
+        assert "FLIP serve/stream" not in joined
+
+
 class TestMain:
     def _run(self, capsys, monkeypatch, text):
         import io

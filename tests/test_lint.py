@@ -393,6 +393,59 @@ def test_jgl006_silent_without_declaration(tmp_path):
     assert findings == []
 
 
+def test_jgl006_standalone_subsystem_lint_uses_production_axes(tmp_path):
+    """Linting inference//serving//streaming/ WITHOUT parallel/mesh.py in
+    the set must still judge PartitionSpec axes against the production
+    declarer's axes (lint.production_declared_axes fallback): a typo'd
+    axis in a serving module silently replicates — the exact JGL006
+    hazard — and the pre-fallback engine went silent on standalone
+    lints."""
+    from raft_ncup_tpu.analysis.lint import run_lint
+
+    for sub in ("inference", "serving", "streaming"):
+        d = tmp_path / sub
+        d.mkdir()
+        (d / "sharded.py").write_text(
+            textwrap.dedent(
+                """
+                from jax.sharding import PartitionSpec as P
+
+                BAD = P("spatail")
+                GOOD = P("data", "spatial")
+                """
+            )
+        )
+        result = run_lint([str(d)])
+        assert result.declared_axes >= {"data", "spatial"}, sub
+        assert [f.rule for f in result.findings] == ["JGL006"], sub
+        assert "spatail" in result.findings[0].message
+
+
+def test_jgl006_standalone_subsystem_negative_declared_axes(tmp_path):
+    """The negative half: standalone subsystem files whose PartitionSpecs
+    name only declared production axes lint clean under the fallback."""
+    from raft_ncup_tpu.analysis.lint import run_lint
+
+    d = tmp_path / "serving"
+    d.mkdir()
+    (d / "ok.py").write_text(
+        textwrap.dedent(
+            """
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def shardings(mesh):
+                return {
+                    "image1": NamedSharding(mesh, P("data", "spatial")),
+                    "table": NamedSharding(mesh, P("data")),
+                    "repl": NamedSharding(mesh, P()),
+                }
+            """
+        )
+    )
+    result = run_lint([str(d)])
+    assert result.findings == []
+
+
 # --------------------------------------------------------------- JGL007
 
 

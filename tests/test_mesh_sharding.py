@@ -1,0 +1,253 @@
+"""Mesh-first inference/serving/streaming (docs/SHARDING.md).
+
+Sharding regressions must fail fast, not only under ``-m slow``
+(tests/test_highres.py keeps the 1080p-scale claims): these tests run
+the REAL subsystems on the forced 8-virtual-device CPU platform
+(tests/conftest.py) at small shapes and pin
+
+- ``make_mesh`` device-coverage honesty (a stripped device is a loud
+  warning, never silence),
+- the mesh fingerprint in every ``ShapeCachedForward`` cache key
+  (sharded and unsharded executables can never collide),
+- sharded-vs-unsharded numerical parity for the forward, the serving
+  data path, the streaming warm-start step, and an eval validator pass,
+- the guard-clean steady state (zero implicit host transfers, zero
+  steady-state recompiles) under the mesh.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from raft_ncup_tpu.config import (
+    ServeConfig,
+    StreamConfig,
+    small_model_config,
+)
+from raft_ncup_tpu.inference.pipeline import ShapeCachedForward
+from raft_ncup_tpu.models import get_model
+from raft_ncup_tpu.parallel.mesh import make_mesh, mesh_fingerprint
+
+HW = (32, 32)  # h8=4: divides spatial=2, tiny compiles
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    cfg = small_model_config("raft", dataset="chairs")
+    model = get_model(cfg)
+    variables = model.init(jax.random.PRNGKey(0), (1, *HW, 3))
+    return model, variables
+
+
+def _mesh(data=1, spatial=2):
+    return make_mesh(
+        data=data, spatial=spatial, devices=jax.devices()[: data * spatial]
+    )
+
+
+def _img(seed, hw=HW, batch=1):
+    g = np.random.default_rng(seed)
+    return (g.random((batch, *hw, 3)) * 255.0).astype(np.float32)
+
+
+# ------------------------------------------------------------- make_mesh
+
+
+class TestMakeMesh:
+    def test_warns_loudly_when_devices_stripped(self):
+        """Satellite regression: data*spatial < n used to silently strip
+        the extra devices — a mis-sized mesh that idles 6 of 8 chips
+        must announce itself."""
+        with pytest.warns(UserWarning, match="only 2 of 8"):
+            mesh = make_mesh(data=1, spatial=2)
+        assert dict(mesh.shape) == {"data": 1, "spatial": 2}
+
+    def test_exact_coverage_is_silent(self):
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            mesh = make_mesh(data=4, spatial=2)  # exactly the 8 devices
+            make_mesh(data=1, spatial=2, devices=jax.devices()[:2])
+        assert dict(mesh.shape) == {"data": 4, "spatial": 2}
+
+    def test_oversubscription_still_raises(self):
+        with pytest.raises(ValueError, match="needs 16 devices"):
+            make_mesh(data=8, spatial=2)
+
+    def test_fingerprint_identity(self):
+        assert mesh_fingerprint(None) == "nomesh"
+        fp = mesh_fingerprint(_mesh(1, 2))
+        assert fp == "mesh(data=1,spatial=2:cpu)"
+        assert fp != mesh_fingerprint(_mesh(2, 1))
+
+
+# -------------------------------------------------- cache-key isolation
+
+
+class _DummyModel:
+    """apply()-compatible stand-in: cache-key tests need no compile."""
+
+    def apply(self, variables, image1, image2, **kw):
+        return image1, image2
+
+
+class TestMeshKeyedCache:
+    def test_every_cache_key_carries_the_mesh_fingerprint(self):
+        mesh = _mesh(1, 2)
+        sharded = ShapeCachedForward(_DummyModel(), {}, mesh=mesh)
+        plain = ShapeCachedForward(_DummyModel(), {})
+
+        def build():
+            return lambda *a: a
+
+        sharded.custom(("stream", 2), build)
+        plain.custom(("stream", 2), build)
+        (skey,) = sharded._fns
+        (pkey,) = plain._fns
+        assert skey[0] == mesh_fingerprint(mesh)
+        assert pkey[0] == "nomesh"
+        assert skey != pkey  # same logical key, different executables
+
+    def test_config_rejects_batch_not_divisible_by_data_axis(self):
+        with pytest.raises(ValueError, match="not divisible by mesh"):
+            ServeConfig(batch_sizes=(1, 2), mesh=(2, 1))
+        with pytest.raises(ValueError, match="not divisible by mesh"):
+            StreamConfig(batch_sizes=(1, 2, 4), mesh=(4, 2))
+        # data=1 spatial-only meshes impose nothing on batch sizes.
+        assert ServeConfig(mesh=(1, 2)).mesh == (1, 2)
+
+    def test_config_rejects_pad_bucket_off_the_mesh_divisor(self):
+        """Mesh pads round to 8*spatial, and InputPadder rejects a
+        bucket the divisor doesn't divide — that must be a config-time
+        error, not an exception escaping FlowServer.submit() past the
+        terminal-status contract."""
+        with pytest.raises(ValueError, match="pad divisor 8\\*spatial"):
+            ServeConfig(mesh=(1, 3), pad_bucket=64)
+        with pytest.raises(ValueError, match="pad divisor 8\\*spatial"):
+            StreamConfig(mesh=(1, 3), pad_bucket=64)
+        # A bucket the divisor divides is fine.
+        assert ServeConfig(mesh=(1, 2), pad_bucket=32).pad_bucket == 32
+
+    def test_cli_mesh_spec(self):
+        import argparse
+
+        from raft_ncup_tpu.cli import str2mesh
+
+        assert str2mesh("1,2") == (1, 2)
+        with pytest.raises(argparse.ArgumentTypeError):
+            str2mesh("2")
+        with pytest.raises(argparse.ArgumentTypeError):
+            str2mesh("0,2")
+
+
+# ------------------------------------------------------ forward parity
+
+
+class TestShardedParity:
+    def test_forward_sharded_matches_unsharded(self, small_model):
+        model, variables = small_model
+        plain = ShapeCachedForward(model, variables)
+        sharded = ShapeCachedForward(model, variables, mesh=_mesh(1, 2))
+        i1, i2 = _img(1), _img(2)
+        lr_p, up_p = plain(i1, i2, iters=2)
+        lr_s, up_s = sharded(i1, i2, iters=2)
+        np.testing.assert_allclose(lr_s, lr_p, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(up_s, up_p, rtol=1e-4, atol=1e-4)
+
+    def test_eval_validator_sharded_parity(self, small_model):
+        """The tier-1 eval parity check (promoted out of the slow tier):
+        a (2 data x 2 spatial) mesh validator pass over the held-out
+        synthetic split must reproduce the unsharded EPE — this is the
+        whole-pipeline parity (EvalPipeline staging shardings + on-device
+        metric fold + SPMD forward), small enough to fail fast on every
+        run."""
+        from raft_ncup_tpu.evaluation import validate_synthetic
+
+        model, variables = small_model
+        kw = dict(
+            iters=2, batch_size=2, size_hw=(64, 64), length=4, seed=999
+        )
+        ref = validate_synthetic(model, variables, None, **kw)
+        out = validate_synthetic(
+            model, variables, None, mesh=_mesh(2, 2), **kw
+        )
+        assert ref and out
+        np.testing.assert_allclose(
+            out["synthetic"], ref["synthetic"], rtol=1e-4
+        )
+
+    def test_serve_sharded_parity(self, small_model):
+        """One request through a spatially-sharded FlowServer must return
+        the same flow as the unsharded server (pads ride 8*spatial, the
+        compiled program is SPMD, the drain pull is unchanged)."""
+        from raft_ncup_tpu.serving import FlowServer
+
+        model, variables = small_model
+        cfg = ServeConfig(batch_sizes=(1,), iter_levels=(2,))
+        img1, img2 = _img(3)[0], _img(4)[0]
+        flows = {}
+        for tag, mesh in (("plain", None), ("sharded", _mesh(1, 2))):
+            with FlowServer(model, variables, cfg, mesh=mesh) as server:
+                res = server.submit(img1, img2).result(timeout=120.0)
+                assert res.ok, res.detail
+                flows[tag] = res.flow
+                assert server.report()["mesh"] == mesh_fingerprint(mesh)
+        assert flows["plain"].shape == flows["sharded"].shape == (*HW, 2)
+        np.testing.assert_allclose(
+            flows["sharded"], flows["plain"], rtol=1e-4, atol=1e-4
+        )
+
+    def test_stream_sharded_parity_and_guard_clean(self, small_model):
+        """Two warm-chained frames through a spatially-sharded
+        StreamEngine (mesh from StreamConfig.mesh — the serve.py --mesh
+        path) must match the unsharded engine bitwise-or-tolerance on
+        BOTH frames (the second one exercises the sharded slot-table
+        gather → in-graph splat → scatter chain), and the sharded steady
+        state must stay guard-clean: zero implicit host transfers, zero
+        recompiles after warmup."""
+        from raft_ncup_tpu.analysis.guards import (
+            GuardStats,
+            RecompileWatchdog,
+            forbid_host_transfers,
+        )
+        from raft_ncup_tpu.streaming import StreamEngine
+
+        model, variables = small_model
+        frames = [(_img(5)[0], _img(6)[0]), (_img(6)[0], _img(7)[0])]
+        results = {}
+        for tag, mesh_spec in (("plain", None), ("sharded", (1, 2))):
+            cfg = StreamConfig(
+                capacity=1, frame_hw=HW, iters=2, batch_sizes=(1,),
+                queue_capacity=8, mesh=mesh_spec,
+            )
+            eng = StreamEngine(model, variables, cfg)
+            try:
+                eng.warmup()
+                out = []
+                stats = GuardStats()
+                with RecompileWatchdog() as wd, forbid_host_transfers(
+                    stats
+                ):
+                    for i1, i2 in frames:
+                        r = eng.submit("s", i1, i2).result(timeout=120.0)
+                        assert r.ok, r.detail
+                        out.append(r.flow)
+                results[tag] = out
+                assert wd.count == 0, f"{tag}: recompiled under traffic"
+                assert stats.host_transfers == 0, tag
+                assert eng.report()["mesh"] == (
+                    "mesh(data=1,spatial=2:cpu)"
+                    if mesh_spec
+                    else "nomesh"
+                )
+            finally:
+                eng.drain()
+        for k in range(2):
+            np.testing.assert_allclose(
+                results["sharded"][k], results["plain"][k],
+                rtol=1e-4, atol=1e-4,
+                err_msg=f"frame {k} (k=1 is the warm-started one)",
+            )
