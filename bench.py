@@ -1002,12 +1002,22 @@ def _measure_serve(
     )
     from raft_ncup_tpu.config import ServeConfig, flagship_config
     from raft_ncup_tpu.models.raft import get_model
+    from raft_ncup_tpu.observability import Telemetry
     from raft_ncup_tpu.serving import FlowServer, SyntheticTraffic, replay
 
     B, H, W = shape["batch"], shape["height"], shape["width"]
     iters = shape["iters"]
     n = n_requests or int(os.environ.get("BENCH_SERVE_REQUESTS", "16"))
     strict = os.environ.get("BENCH_STRICT_GUARDS") == "1"
+    # Telemetry-off comparison window (the observer-overhead row;
+    # docs/OBSERVABILITY.md methodology). BENCH_SKIP_TELEMETRY_COMPARE=1
+    # skips it (fields absent); the bf16 twin skips it too — the
+    # observer-overhead question is precision-independent and the f32
+    # row already answers it.
+    tel_compare = (
+        os.environ.get("BENCH_SKIP_TELEMETRY_COMPARE") != "1"
+        and precision == "f32"
+    )
 
     # Two budget levels at the bench shape: the idle-load level is the
     # row's headline; the lower level exists so the warmup compiles the
@@ -1027,7 +1037,10 @@ def _measure_serve(
             corr_impl=corr_impl,
         )
     )
-    server = FlowServer(model, variables, cfg)
+    # Fresh telemetry hub per row: the window's counters/spans are
+    # isolated from the process default and from other rows.
+    tel = Telemetry()
+    server = FlowServer(model, variables, cfg, telemetry=tel)
     try:
         server.warmup((H, W))
         # Calibrate the open-loop rate on the warm top-level executable:
@@ -1043,6 +1056,14 @@ def _measure_serve(
         with RecompileWatchdog() as wd, forbid_host_transfers(
             stats, raise_on_violation=strict
         ):
+            # Window A — telemetry FULLY ENABLED (counters, spans, queue
+            # gauges): the headline serve_* numbers, and the guard
+            # counters prove 0 recompiles / 0 implicit transfers hold
+            # under full tracing. Counter deltas bracket the window so
+            # the sanctioned-get consistency check (flip_recommendations)
+            # compares like with like.
+            batches_before = server.stats.batches
+            pulls_before = tel.counter_value("serve_drain_pulls_total")
             traffic = SyntheticTraffic(
                 (H, W), n, seed=91, interval_s=interval, style="rigid"
             )
@@ -1050,6 +1071,40 @@ def _measure_serve(
             handles, _ = replay(server, traffic)
             responses = [h.result(timeout=120.0) for h in handles]
             dt = time.perf_counter() - t0
+            batches_in_window = server.stats.batches - batches_before
+            pulls_in_window = int(
+                tel.counter_value("serve_drain_pulls_total") - pulls_before
+            )
+            stages = server.report()["stages"]
+            # Snapshot the window-A health counters BEFORE window B: the
+            # record's shed/timeouts/errors/budget_drops must describe
+            # the window the headline latencies came from, not absorb a
+            # later off-window hiccup (flip_recommendations disqualifies
+            # rows on these).
+            win_a = {
+                "shed": server.stats.shed,
+                "timeouts": server.stats.timeouts,
+                "errors": server.stats.errors,
+                "budget_drops": server.budget.drops,
+            }
+            # Window B — SAME warm server, same rate, telemetry
+            # DISABLED: the p50 delta is the measured observer overhead.
+            responses_off, dt_off = [], None
+            if tel_compare:
+                tel.enabled = False
+                try:
+                    traffic_off = SyntheticTraffic(
+                        (H, W), n, seed=94, interval_s=interval,
+                        style="rigid",
+                    )
+                    t0 = time.perf_counter()
+                    handles_off, _ = replay(server, traffic_off)
+                    responses_off = [
+                        h.result(timeout=120.0) for h in handles_off
+                    ]
+                    dt_off = time.perf_counter() - t0
+                finally:
+                    tel.enabled = True
     finally:
         server.drain()
 
@@ -1062,7 +1117,7 @@ def _measure_serve(
     if not lat:
         raise RuntimeError(f"no ok responses in serve window: "
                            f"{sstats.summary()}")
-    return {
+    record = {
         "serve_pairs_per_sec": round(len(lat) / dt, 4) if dt > 0 else 0.0,
         "serve_p50_ms": nearest_rank_ms(lat, 0.50),
         "serve_p99_ms": nearest_rank_ms(lat, 0.99),
@@ -1070,14 +1125,38 @@ def _measure_serve(
         "serve_ok": len(lat),
         "serve_interval_ms": round(interval * 1e3, 1),
         "serve_iters": levels[0],
-        "serve_shed": sstats.shed,
-        "serve_timeouts": sstats.timeouts,
-        "serve_errors": sstats.errors,
-        "serve_budget_drops": server.budget.drops,
+        "serve_shed": win_a["shed"],
+        "serve_timeouts": win_a["timeouts"],
+        "serve_errors": win_a["errors"],
+        "serve_budget_drops": win_a["budget_drops"],
         "serve_mesh": server.report()["mesh"],
         "serve_recompiles": wd.count,
         "serve_host_transfers": stats.host_transfers,
+        # Telemetry snapshot consistency (flip_recommendations): the
+        # drain worker's pull counter vs the dispatcher's batch count —
+        # two independent measurements of the same window that must
+        # agree on a clean run.
+        "serve_batches": batches_in_window,
+        "serve_sanctioned_gets": pulls_in_window,
+        # Per-stage p50/p99 breakdown from the span tracer (includes
+        # warm calibration traffic; the stage shape, not the headline).
+        "serve_stages": stages,
     }
+    lat_off = [
+        r.latency_s
+        for r in responses_off
+        if r.ok and r.latency_s is not None
+    ]
+    if lat_off and dt_off:
+        p50_on = record["serve_p50_ms"]
+        p50_off = nearest_rank_ms(lat_off, 0.50)
+        record["serve_p50_ms_notelemetry"] = p50_off
+        record["serve_p99_ms_notelemetry"] = nearest_rank_ms(lat_off, 0.99)
+        if p50_off:
+            record["serve_telemetry_overhead_pct"] = round(
+                100.0 * (p50_on - p50_off) / p50_off, 2
+            )
+    return record
 
 
 def _measure_stream(
@@ -1116,6 +1195,7 @@ def _measure_stream(
     )
     from raft_ncup_tpu.config import StreamConfig, flagship_config
     from raft_ncup_tpu.models.raft import get_model
+    from raft_ncup_tpu.observability import Telemetry
     from raft_ncup_tpu.serving import nearest_rank_ms
     from raft_ncup_tpu.streaming import (
         StreamEngine,
@@ -1144,7 +1224,8 @@ def _measure_stream(
             corr_impl=corr_impl,
         )
     )
-    engine = StreamEngine(model, variables, cfg)
+    tel = Telemetry()  # fresh hub: bench-window isolation
+    engine = StreamEngine(model, variables, cfg, telemetry=tel)
     try:
         engine.warmup()
         # Calibrate per-frame service time on the warm executables.
@@ -1162,6 +1243,10 @@ def _measure_stream(
         with RecompileWatchdog() as wd, forbid_host_transfers(
             stats, raise_on_violation=strict
         ):
+            # Telemetry fully enabled through the window; counter deltas
+            # bracket it for the snapshot-consistency check.
+            batches_before = engine.stats.batches
+            pulls_before = tel.counter_value("stream_drain_pulls_total")
             traffic = StreamTraffic(
                 (H, W), n_streams, frames, seed=93,
                 interval_s=interval, style="rigid",
@@ -1170,6 +1255,11 @@ def _measure_stream(
             handles, _ = replay_streams(engine, traffic)
             responses = [h.result(timeout=120.0) for h in handles]
             dt = time.perf_counter() - t0
+            batches_in_window = engine.stats.batches - batches_before
+            pulls_in_window = int(
+                tel.counter_value("stream_drain_pulls_total")
+                - pulls_before
+            )
         report = engine.report()
     finally:
         engine.drain()
@@ -1201,6 +1291,10 @@ def _measure_stream(
         "stream_mesh": report["mesh"],
         "stream_recompiles": wd.count,
         "stream_host_transfers": stats.host_transfers,
+        # Snapshot consistency + per-stage breakdown (observability/).
+        "stream_batches": batches_in_window,
+        "stream_sanctioned_gets": pulls_in_window,
+        "stream_stages": report["stages"],
     }
 
 

@@ -136,6 +136,13 @@ def _record_violation(desc: str) -> None:
         entry.stats.host_transfers += 1
         entry.stats.violations.append(desc)
         raise_on_violation = entry.raise_on_violation
+    # Mirror into the unified telemetry stream (observability/): a guard
+    # violation is exactly the kind of lifecycle fact a later stall
+    # diagnosis wants on the correlated timeline. GuardStats stays the
+    # scope-local source of truth.
+    from raft_ncup_tpu.observability import get_telemetry
+
+    get_telemetry().event("guard_host_transfer_violation", desc=desc)
     if raise_on_violation:
         raise GuardViolation(
             f"implicit device->host transfer under forbid_host_transfers: "
@@ -191,6 +198,12 @@ def _install() -> None:
             entry = next((e for e in reversed(_active) if e.armed), None)
             if entry is not None:
                 entry.stats.sanctioned_gets += 1
+        if entry is not None:
+            # Canonical counter for GuardStats.sanctioned_gets (host
+            # int bump — the pull itself is unaffected).
+            from raft_ncup_tpu.observability import get_telemetry
+
+            get_telemetry().inc("guard_sanctioned_gets_total")
         prev = getattr(_tl, "sanctioned", False)
         _tl.sanctioned = True
         try:
@@ -258,6 +271,9 @@ class RecompileWatchdog:
     def _listener(self, event: str, duration: float, **kw) -> None:
         if self._armed and event.startswith(_COMPILE_EVENT):
             self.count += 1
+            from raft_ncup_tpu.observability import get_telemetry
+
+            get_telemetry().inc("guard_recompiles_total")
 
     def arm(self) -> None:
         self._armed = True

@@ -298,7 +298,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         prog="python -m raft_ncup_tpu.analysis",
         description="graftlint: JAX-aware static analysis enforcing the "
         "sync-free, recompile-free hot path and honest error handling "
-        "(rules JGL001-JGL008).",
+        "(rules JGL001-JGL010).",
     )
     parser.add_argument("paths", nargs="*", default=["raft_ncup_tpu"],
                         help="files/directories to lint (default: the "

@@ -19,6 +19,7 @@ from raft_ncup_tpu.analysis.rules import (
     jgl007_swallowed_exceptions,
     jgl008_eval_loop_pulls,
     jgl009_precision_policy,
+    jgl010_telemetry_isolation,
 )
 
 ALL_RULES = (
@@ -31,6 +32,7 @@ ALL_RULES = (
     jgl007_swallowed_exceptions,
     jgl008_eval_loop_pulls,
     jgl009_precision_policy,
+    jgl010_telemetry_isolation,
 )
 
 RULES_BY_ID = {mod.RULE_ID: mod for mod in ALL_RULES}

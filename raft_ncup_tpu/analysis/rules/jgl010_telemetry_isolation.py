@@ -1,0 +1,127 @@
+"""JGL010 — device-array access inside the telemetry subsystem.
+
+The observability package's hard constraint is the platform's own,
+inverted: every other subsystem must not *leak* host syncs; telemetry
+must not *add* them. A metrics registry that calls ``float()`` on a
+device scalar, a span that stashes a ``jax.Array`` in its attrs, a
+snapshot thread that ``np.asarray``-pulls a buffer — each would put a
+device round-trip on the hot path *from the observer*, and an observer
+that perturbs the observed steady state is worse than none (the bench's
+telemetry-on-vs-off overhead row measures exactly this).
+
+So ``raft_ncup_tpu/observability/`` is host-only stdlib by construction,
+and this rule enforces it statically:
+
+- **no jax import at all** (``import jax``, ``from jax import ...``,
+  ``import jax.numpy``): the package must stay importable — and
+  correct — on hosts where touching jax would initialize a backend,
+  exactly like ``analysis/`` itself;
+- **no device pulls**: ``jax.device_get`` / ``device_put`` /
+  ``block_until_ready`` calls (however aliased), and the implicit-pull
+  shapes the runtime guard intercepts — ``.item()`` / ``.tolist()``
+  method calls and ``numpy.asarray`` / ``numpy.array`` calls.
+
+Values crossing into telemetry must already be host scalars, pulled at
+the producers' sanctioned boundaries (the AsyncDrain worker's one
+``device_get`` per batch, the Logger's one per window);
+``telemetry.host_number`` backs this rule up at runtime by rejecting
+jax-typed values before any conversion could sync.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from raft_ncup_tpu.analysis.astutil import (
+    Finding,
+    ModuleContext,
+    dotted_name,
+    qualname,
+)
+
+RULE_ID = "JGL010"
+SUMMARY = (
+    "jax import or device-array access inside observability/ — telemetry "
+    "is host-only and must never add a sync"
+)
+
+_JAX_CALLS = frozenset(
+    {
+        "jax.device_get",
+        "jax.device_put",
+        "jax.block_until_ready",
+    }
+)
+_NUMPY_PULLS = frozenset({"numpy.asarray", "numpy.array"})
+_METHOD_PULLS = frozenset({"item", "tolist"})
+
+
+def _in_scope(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return "/observability/" in p or p.startswith("observability/")
+
+
+def check(ctx: ModuleContext) -> Iterator[Finding]:
+    if not _in_scope(ctx.path):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                root = alias.name.split(".")[0]
+                if root == "jax":
+                    yield Finding(
+                        ctx.path, node.lineno, node.col_offset, RULE_ID,
+                        f"`import {alias.name}` in observability/: "
+                        "telemetry is host-only stdlib — a jax import "
+                        "here puts device-array access (and backend "
+                        "initialization) one attribute away from every "
+                        "metric call; record host scalars pulled at the "
+                        "producers' sanctioned boundaries instead",
+                        qualname(node),
+                    )
+        elif isinstance(node, ast.ImportFrom):
+            root = (node.module or "").split(".")[0]
+            if root == "jax":
+                yield Finding(
+                    ctx.path, node.lineno, node.col_offset, RULE_ID,
+                    f"`from {node.module} import ...` in observability/: "
+                    "telemetry is host-only stdlib (see JGL010)",
+                    qualname(node),
+                )
+        elif isinstance(node, ast.Call):
+            dn = dotted_name(node.func, ctx.aliases)
+            if dn in _JAX_CALLS or (
+                dn is not None and dn.split(".")[0] == "jax"
+            ):
+                yield Finding(
+                    ctx.path, node.lineno, node.col_offset, RULE_ID,
+                    f"`{dn}` call in observability/: a device access "
+                    "inside telemetry adds the very sync the guarded "
+                    "hot path forbids — pull at the producer's "
+                    "sanctioned boundary and hand telemetry the host "
+                    "scalar",
+                    qualname(node),
+                )
+            elif dn in _NUMPY_PULLS:
+                yield Finding(
+                    ctx.path, node.lineno, node.col_offset, RULE_ID,
+                    f"`{dn}` call in observability/: on a jax array this "
+                    "is an implicit device→host pull (the runtime "
+                    "guard's exact intercept list) — telemetry receives "
+                    "host numbers, it never converts",
+                    qualname(node),
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METHOD_PULLS
+                and not node.args
+                and not node.keywords
+            ):
+                yield Finding(
+                    ctx.path, node.lineno, node.col_offset, RULE_ID,
+                    f"`.{node.func.attr}()` call in observability/: on a "
+                    "jax array this is an implicit device→host pull — "
+                    "telemetry receives host numbers, it never converts",
+                    qualname(node),
+                )

@@ -53,7 +53,11 @@ import jax.numpy as jnp
 
 from raft_ncup_tpu.data.device_prefetch import DevicePrefetcher
 from raft_ncup_tpu.inference import metrics as metrics_mod
+from raft_ncup_tpu.observability import get_telemetry
+from raft_ncup_tpu.observability.telemetry import LEGACY_KEY_ALIASES
 from raft_ncup_tpu.precision import resolve_policy
+
+_EXEC_CANON = LEGACY_KEY_ALIASES["inference"]
 
 
 class SamplePrefetcher:
@@ -361,7 +365,7 @@ class ShapeCachedForward:
 
     def __init__(
         self, model, variables: dict, mesh=None, cache_size: int = 8,
-        policy=None,
+        policy=None, telemetry=None,
     ):
         from raft_ncup_tpu.parallel.mesh import mesh_fingerprint
 
@@ -385,6 +389,12 @@ class ShapeCachedForward:
         self._fns: OrderedDict = OrderedDict()
         self._models_by_policy: dict = {}
         self.stats = {"compiles": 0, "hits": 0, "evictions": 0}
+        # Telemetry (observability/): compile/evict land as ring events
+        # keyed exactly like the cache (the full executable key string),
+        # all three land as canonical counters. Hits are counter-only —
+        # one ring event per warm batch would flood the span ring with
+        # the steady state the ring exists to contextualize.
+        self._tel = telemetry if telemetry is not None else get_telemetry()
 
     def model_for(self, policy=None):
         """Resolve (model, policy) for one call: the instance model when
@@ -433,13 +443,18 @@ class ShapeCachedForward:
         if fn is not None:
             self._fns.move_to_end(key)
             self.stats["hits"] += 1
+            self._tel.inc(_EXEC_CANON["hits"])
             return fn
         fn = build()
         self._fns[key] = fn
         self.stats["compiles"] += 1
+        self._tel.inc(_EXEC_CANON["compiles"])
+        self._tel.event("inference_executable_compile", key=str(key))
         if len(self._fns) > self.cache_size:
             evicted, _ = self._fns.popitem(last=False)
             self.stats["evictions"] += 1
+            self._tel.inc(_EXEC_CANON["evictions"])
+            self._tel.event("inference_executable_evict", key=str(evicted))
             print(
                 f"ShapeCachedForward: EVICTING compiled executable "
                 f"{evicted} (LRU bound {self.cache_size}). Recurring "

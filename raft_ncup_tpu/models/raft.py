@@ -242,14 +242,18 @@ class RAFT:
             return module.apply(v, *args, rngs=rngs, **kwargs)
 
         # Siamese feature extraction: both frames through fnet in one batch
-        # (reference: core/extractor.py:168-174).
-        fmaps = run(
-            "fnet",
-            self.fnet,
-            jnp.concatenate([img1, img2], axis=0),
-            train=train,
-            bn_train=bn_train,
-        )
+        # (reference: core/extractor.py:168-174). jax.named_scope labels
+        # carry into the HLO metadata, so an xprof capture of this
+        # program is stage-labeled (docs/OBSERVABILITY.md) — staged for
+        # the ROADMAP item-1 hardware window.
+        with jax.named_scope("raft.fnet"):
+            fmaps = run(
+                "fnet",
+                self.fnet,
+                jnp.concatenate([img1, img2], axis=0),
+                train=train,
+                bn_train=bn_train,
+            )
         fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
         # Correlation features/volume ride the policy's corr dtype — the
         # dominant memory term, so the bf16 presets halve it (and double
@@ -340,7 +344,10 @@ class RAFT:
         else:
             raise ValueError(f"unknown corr_impl: {cfg.corr_impl!r}")
 
-        cnet_out = run("cnet", self.cnet, img1, train=train, bn_train=bn_train)
+        with jax.named_scope("raft.cnet"):
+            cnet_out = run(
+                "cnet", self.cnet, img1, train=train, bn_train=bn_train
+            )
         net = jnp.tanh(cnet_out[..., :hdim])
         inp = jax.nn.relu(cnet_out[..., hdim:])
         if net_init is not None:
@@ -399,16 +406,21 @@ class RAFT:
             if "upsampler" in stats:
                 bstats["upsampler"] = stats["upsampler"]
             coords1 = jax.lax.stop_gradient(coords1)  # .detach() per iter
-            corr = corr_fn(coords1)
+            # Stage labels inside the scanned refinement iteration: the
+            # lookup and the GRU update are the two halves an xprof
+            # trace needs separated (correlation memory wall vs compute).
+            with jax.named_scope("raft.corr_lookup"):
+                corr = corr_fn(coords1)
             flow = coords1 - coords0
-            net, up_mask, delta = run(
-                "update_block",
-                self.update_block,
-                net,
-                inp,
-                corr,
-                flow.astype(net.dtype),
-            )
+            with jax.named_scope("raft.update_block"):
+                net, up_mask, delta = run(
+                    "update_block",
+                    self.update_block,
+                    net,
+                    inp,
+                    corr,
+                    flow.astype(net.dtype),
+                )
             # The coordinate carry is the refinement's f32 backbone: the
             # (possibly bf16) delta joins it at the policy's pinned
             # coord dtype, so per-iteration compute error never narrows
@@ -438,18 +450,21 @@ class RAFT:
         if train and remat:
             body = jax.checkpoint(step)
 
-        (net, coords1, final_stats), flow_seq = jax.lax.scan(
-            body, (net, coords1, init_stats), None, length=iters
-        )
+        with jax.named_scope("raft.refinement"):
+            (net, coords1, final_stats), flow_seq = jax.lax.scan(
+                body, (net, coords1, init_stats), None, length=iters
+            )
         if "upsampler" in final_stats:
             bstats["upsampler"] = final_stats["upsampler"]
 
         if test_mode:
-            flow_up = upsample_prediction(
-                coords1, net, final_stats.get("up_mask")
-            ).astype(policy.output_jnp)  # serving/metrics contract: f32
+            with jax.named_scope("raft.upsample"):
+                flow_up = upsample_prediction(
+                    coords1, net, final_stats.get("up_mask")
+                ).astype(policy.output_jnp)  # serving/metrics contract: f32
             if metric_head is not None:
-                flow_up = metric_head(flow_up)
+                with jax.named_scope("raft.metric_head"):
+                    flow_up = metric_head(flow_up)
             if return_net:
                 result = (coords1 - coords0, flow_up, net)
             else:

@@ -1,0 +1,57 @@
+"""Unified telemetry subsystem: metrics registry, span tracer, export
+layer (docs/OBSERVABILITY.md).
+
+One registry, one event stream, every subsystem a producer — serving,
+streaming, inference, and the resilience layer all mirror their
+accounting here without changing a single legacy ``report()`` key
+(``telemetry.LEGACY_KEY_ALIASES`` is the pinned map).
+
+Host-only by construction: nothing in this package may import jax,
+touch a device array, or add a sync — lint rule JGL010 enforces it
+statically, ``telemetry.host_number`` at runtime, and the bench's
+telemetry-on-vs-off overhead row measures it.
+"""
+
+from raft_ncup_tpu.observability.export import (
+    JsonlSink,
+    PeriodicSnapshot,
+    Telemetry,
+    get_telemetry,
+    prometheus_text,
+    set_telemetry,
+    telemetry_report,
+)
+from raft_ncup_tpu.observability.spans import (
+    NOOP_SPAN,
+    Span,
+    SpanTracer,
+)
+from raft_ncup_tpu.observability.telemetry import (
+    DEFAULT_BUCKETS_MS,
+    LEGACY_KEY_ALIASES,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    host_number,
+)
+
+__all__ = [
+    "Counter",
+    "DEFAULT_BUCKETS_MS",
+    "Gauge",
+    "Histogram",
+    "JsonlSink",
+    "LEGACY_KEY_ALIASES",
+    "MetricsRegistry",
+    "NOOP_SPAN",
+    "PeriodicSnapshot",
+    "Span",
+    "SpanTracer",
+    "Telemetry",
+    "get_telemetry",
+    "host_number",
+    "prometheus_text",
+    "set_telemetry",
+    "telemetry_report",
+]

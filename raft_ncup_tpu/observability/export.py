@@ -1,0 +1,238 @@
+"""Telemetry export layer: the process-wide hub, a bounded JSONL event
+sink, periodic snapshots, a Prometheus text dump, and the one
+``telemetry_report()`` dict that ``serve.py --report`` and ``bench.py``
+both read.
+
+The :class:`Telemetry` hub bundles one :class:`MetricsRegistry` and one
+:class:`SpanTracer` behind no-op-when-disabled facade methods — every
+producer call site does ``tel.inc(...)`` / ``with tel.span(...)``
+unconditionally, and a disabled hub reduces each to a bool check. That
+is also how the bench measures telemetry's own overhead honestly: the
+serve row runs the SAME warm window with the hub enabled and disabled
+and records the p50 delta (docs/PERF.md; the acceptance bar is <= 3% of
+p50 on CPU).
+
+One process-wide default hub (:func:`get_telemetry`) is what the serving
+and streaming constructors bind when not handed an explicit hub; tests
+and bench windows pass their own for isolation. ``RAFT_NCUP_TELEMETRY=0``
+disables the default hub at creation.
+
+Like the rest of ``observability/``: pure stdlib, no jax (JGL010) — the
+sink writes host dicts, the snapshot thread reads host counters, and
+nothing here can ever touch a device array or add a sync.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from raft_ncup_tpu.observability.spans import (
+    NOOP_SPAN,
+    SpanTracer,
+)
+from raft_ncup_tpu.observability.telemetry import MetricsRegistry
+
+TELEMETRY_ENV = "RAFT_NCUP_TELEMETRY"
+
+
+class Telemetry:
+    """Registry + tracer behind one enable flag. The facade methods are
+    the ONLY producer API the rest of the codebase uses, so flipping
+    ``enabled`` turns the entire telemetry surface on/off at once."""
+
+    def __init__(
+        self,
+        enabled: bool = True,
+        span_capacity: int = 2048,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.registry = MetricsRegistry()
+        self.tracer = SpanTracer(
+            self.registry, capacity=span_capacity, clock=clock
+        )
+        self.enabled = bool(enabled)
+
+    # ---------------------------------------------------------- producers
+
+    def inc(self, name: str, n=1) -> None:
+        if self.enabled:
+            self.registry.counter(name).inc(n)
+
+    def gauge_set(self, name: str, value) -> None:
+        if self.enabled:
+            self.registry.gauge(name).set(value)
+
+    def observe_ms(self, name: str, ms, **attrs) -> None:
+        if self.enabled:
+            self.tracer.observe_ms(name, ms, **attrs)
+
+    def event(self, name: str, **attrs) -> None:
+        if self.enabled:
+            self.tracer.event(name, **attrs)
+
+    def span(self, name: str, **attrs):
+        if self.enabled:
+            return self.tracer.span(name, **attrs)
+        return NOOP_SPAN
+
+    # ---------------------------------------------------------- consumers
+
+    def counter_value(self, name: str) -> float:
+        m = self.registry.get(name)
+        return 0.0 if m is None else m.value
+
+    def report(self) -> dict:
+        return telemetry_report(self)
+
+    def reset(self) -> None:
+        self.registry.reset()
+        self.tracer.reset()
+
+
+_default_lock = threading.Lock()
+_default: Optional[Telemetry] = None
+
+
+def get_telemetry() -> Telemetry:
+    """The process-wide default hub (created on first use; honors
+    ``RAFT_NCUP_TELEMETRY=0``)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = Telemetry(
+                enabled=os.environ.get(TELEMETRY_ENV, "1") != "0"
+            )
+        return _default
+
+
+def set_telemetry(tel: Optional[Telemetry]) -> Optional[Telemetry]:
+    """Swap the process default (tests/bench isolation); returns the
+    previous hub so callers can restore it."""
+    global _default
+    with _default_lock:
+        prev, _default = _default, tel
+        return prev
+
+
+def telemetry_report(tel: Optional[Telemetry] = None) -> dict:
+    """The one snapshot dict every consumer reads: full registry
+    snapshot, per-stage latency breakdown, and ring accounting."""
+    tel = tel or get_telemetry()
+    return {
+        "enabled": tel.enabled,
+        "metrics": tel.registry.snapshot(),
+        "stages": tel.tracer.stage_summary(),
+        "spans_recorded": len(tel.tracer.records()),
+        "spans_dropped": tel.tracer.dropped,
+    }
+
+
+def prometheus_text(tel: Optional[Telemetry] = None) -> str:
+    """Prometheus text exposition of the hub's registry."""
+    return (tel or get_telemetry()).registry.prometheus_text()
+
+
+class JsonlSink:
+    """Bounded JSONL event sink: one JSON object per line, hard-capped
+    at ``max_events`` lines — beyond the cap events are DROPPED and
+    counted (``dropped``), never buffered or grown: an event sink that
+    can fill a disk is an outage amplifier, and the span ring upstream
+    already keeps the recent past. Thread-safe; ``close()`` appends a
+    final ``jsonl_sink_closed`` record carrying the drop count."""
+
+    def __init__(self, path: str, max_events: int = 100_000):
+        self._path = path
+        self._max = max(1, int(max_events))
+        self._written = 0
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def write(self, record: dict) -> bool:
+        """Append one event; False (and counted) once the cap is hit."""
+        with self._lock:
+            if self._fh.closed:
+                return False
+            if self._written >= self._max:
+                self.dropped += 1
+                return False
+            self._fh.write(json.dumps(record) + "\n")
+            self._written += 1
+            return True
+
+    def flush(self) -> None:
+        with self._lock:
+            if not self._fh.closed:
+                self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh.closed:
+                return
+            if self.dropped:
+                self._fh.write(json.dumps({
+                    "name": "jsonl_sink_closed",
+                    "dropped": self.dropped,
+                }) + "\n")
+            self._fh.close()
+
+    def __enter__(self) -> "JsonlSink":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class PeriodicSnapshot:
+    """Background thread writing ``telemetry_report`` snapshots to a
+    :class:`JsonlSink` every ``interval_s`` (plus one final snapshot at
+    ``stop()``), stamped with wall time — the long-running-server export
+    path (serve.py ``--telemetry_jsonl``)."""
+
+    def __init__(
+        self,
+        tel: Telemetry,
+        sink: JsonlSink,
+        interval_s: float = 10.0,
+    ):
+        self._tel = tel
+        self._sink = sink
+        self._interval = max(0.05, float(interval_s))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="telemetry-snapshot", daemon=True
+        )
+
+    def start(self) -> "PeriodicSnapshot":
+        self._thread.start()
+        return self
+
+    def _write_one(self) -> None:
+        self._sink.write({
+            "name": "telemetry_snapshot",
+            "time_unix_s": round(time.time(), 3),
+            "report": telemetry_report(self._tel),
+        })
+        self._sink.flush()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self._write_one()
+
+    def stop(self) -> None:
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join()
+        self._write_one()
+
+    def __enter__(self) -> "PeriodicSnapshot":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,255 @@
+"""Monotonic-clock span tracer: per-stage latency spans and point
+events, carrying correlation IDs through the serving/streaming/inference
+machinery.
+
+A **span** is one timed stage (``serve_dispatch``, ``stream_drain``…);
+a **point event** is an instant lifecycle fact (``stream_slot_evicted``,
+``io_retry``…). Both carry free-form *correlation attributes* — request
+id, stream id, batch id, mesh fingerprint, precision-policy name — so a
+request's journey through admission → batching → dispatch → drain can be
+reassembled from the record ring afterwards (``for_attr``), which is the
+debugging primitive the multi-replica/multi-segment ROADMAP items need.
+
+Everything here is host-only stdlib (JGL010): the clock is
+``time.monotonic`` (injectable — tests and the serving stack drive it
+deterministically), span records live in a bounded ring (old spans fall
+off; telemetry must never grow without bound), and attribute values are
+validated host scalars/strings — handing a device array to a span is a
+``TypeError`` *before* anything could sync (``telemetry.host_number``).
+
+Finishing a span also feeds ``{name}_ms`` in the metrics registry, so
+per-stage p50/p99 fall out of the same fixed-bucket histograms the rest
+of telemetry uses; a point event feeds ``{name}_total``. xprof-side
+stage labels are NOT this module's job — the ``jax.profiler`` named
+annotations live with the jitted code they label (``models/raft.py``,
+``parallel/step.py``, ``utils/profiling.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from raft_ncup_tpu.observability.telemetry import (
+    MetricsRegistry,
+    host_number,
+)
+
+DEFAULT_SPAN_CAPACITY = 2048
+
+_ATTR_OK_TYPES = (str, bool, type(None))
+
+
+def _host_attr(name: str, key: str, value):
+    """Validate one span attribute as host data (scalar, string, or a
+    small tuple/list of those) — never a device array."""
+    if isinstance(value, _ATTR_OK_TYPES):
+        return value
+    if isinstance(value, (tuple, list)):
+        return [_host_attr(name, key, v) for v in value]
+    if isinstance(value, int):
+        # bool handled above; plain ints (request ids) pass untouched.
+        return value
+    return host_number(value, f"span {name} attr {key!r}")
+
+
+class Span:
+    """One in-progress or finished stage. Created by
+    :meth:`SpanTracer.span`; ``duration_ms`` is valid after exit."""
+
+    __slots__ = ("name", "attrs", "start_s", "end_s")
+
+    def __init__(self, name: str, attrs: dict, start_s: float):
+        self.name = name
+        self.attrs = attrs
+        self.start_s = start_s
+        self.end_s: Optional[float] = None
+
+    @property
+    def duration_ms(self) -> Optional[float]:
+        if self.end_s is None:
+            return None
+        return (self.end_s - self.start_s) * 1000.0
+
+    def set(self, **attrs) -> None:
+        """Attach correlation attributes mid-span (e.g. the batch id is
+        only known after assembly)."""
+        for k, v in attrs.items():
+            self.attrs[k] = _host_attr(self.name, k, v)
+
+    def record(self) -> dict:
+        rec = {"name": self.name, "attrs": dict(self.attrs)}
+        if self.end_s is not None:
+            rec["duration_ms"] = round(self.duration_ms, 3)
+        return rec
+
+
+class _SpanContext:
+    """Context manager yielded by :meth:`SpanTracer.span`."""
+
+    __slots__ = ("_tracer", "span")
+
+    def __init__(self, tracer: "SpanTracer", span: Span):
+        self._tracer = tracer
+        self.span = span
+
+    def __enter__(self) -> Span:
+        return self.span
+
+    def __exit__(self, *exc) -> None:
+        self._tracer._finish(self.span)
+
+
+class _NoopSpan:
+    """Shared do-nothing span for disabled tracers: the hot path pays
+    one attribute lookup and a with-statement, nothing else."""
+
+    __slots__ = ()
+
+    def set(self, **attrs) -> None:
+        pass
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class SpanTracer:
+    """Bounded ring of finished spans + point events, with registry
+    feeding. Thread-safe: clients, the dispatcher, and drain workers all
+    produce concurrently."""
+
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        capacity: int = DEFAULT_SPAN_CAPACITY,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.registry = registry
+        self.clock = clock
+        self._records: deque = deque(maxlen=max(1, int(capacity)))
+        self._dropped = 0
+        self._lock = threading.Lock()
+
+    # -------------------------------------------------------- producers
+
+    def span(self, name: str, **attrs) -> _SpanContext:
+        """``with tracer.span("serve_dispatch", batch_id=7) as sp: ...``
+        — measures wall time on the tracer's monotonic clock, records
+        the span, and observes ``{name}_ms`` in the registry."""
+        checked = {
+            k: _host_attr(name, k, v) for k, v in attrs.items()
+        }
+        return _SpanContext(self, Span(name, checked, self.clock()))
+
+    def _finish(self, span: Span) -> None:
+        span.end_s = self.clock()
+        self._append(span.record())
+        if self.registry is not None:
+            self.registry.histogram(
+                f"{span.name}_ms"
+            ).observe_ms(span.duration_ms)
+
+    def event(self, name: str, **attrs) -> None:
+        """Point event: recorded in the ring and counted as
+        ``{name}_total`` in the registry."""
+        checked = {
+            k: _host_attr(name, k, v) for k, v in attrs.items()
+        }
+        self._append({"name": name, "attrs": checked, "event": True})
+        if self.registry is not None:
+            self.registry.counter(f"{name}_total").inc()
+
+    def observe_ms(self, name: str, ms, **attrs) -> None:
+        """Record an externally-timed duration as if it were a span —
+        the per-request queue-wait case, where the interval's endpoints
+        live in different threads and a context manager cannot wrap it."""
+        ms = host_number(ms, f"span {name} duration")
+        checked = {
+            k: _host_attr(name, k, v) for k, v in attrs.items()
+        }
+        self._append(
+            {"name": name, "attrs": checked, "duration_ms": round(ms, 3)}
+        )
+        if self.registry is not None:
+            self.registry.histogram(f"{name}_ms").observe_ms(ms)
+
+    def _append(self, record: dict) -> None:
+        with self._lock:
+            if len(self._records) == self._records.maxlen:
+                self._dropped += 1
+            self._records.append(record)
+
+    # --------------------------------------------------------- consumers
+
+    def records(self, name: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            recs = list(self._records)
+        if name is None:
+            return recs
+        return [r for r in recs if r["name"] == name]
+
+    def for_attr(self, **match) -> List[dict]:
+        """Correlation query: records whose attrs contain every given
+        key with an equal value — or whose list-valued attr CONTAINS
+        the value. A singular key also matches its plural list attr
+        (``request_id=12`` matches a batch span's ``request_ids``
+        containing 12), so ``tracer.for_attr(request_id=12)``
+        reassembles request 12's whole journey: its own queue-wait plus
+        every batch-level stage that carried it."""
+        out = []
+        for r in self.records():
+            attrs = r["attrs"]
+            ok = True
+            for k, v in match.items():
+                got = attrs.get(k)
+                if got == v:
+                    continue
+                if isinstance(got, list) and v in got:
+                    continue
+                plural = attrs.get(k + "s")
+                if isinstance(plural, list) and v in plural:
+                    continue
+                ok = False
+                break
+            if ok:
+                out.append(r)
+        return out
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def stage_summary(self) -> Dict[str, dict]:
+        """Per-stage latency breakdown from the registry's ``*_ms``
+        histograms: {stage: {count, p50_ms, p99_ms}} — what ``report()``
+        embeds alongside the legacy keys."""
+        if self.registry is None:
+            return {}
+        out: Dict[str, dict] = {}
+        for name in self.registry.names():
+            if not name.endswith("_ms"):
+                continue
+            m = self.registry.get(name)
+            snap_fn = getattr(m, "percentile_ms", None)
+            if snap_fn is None:
+                continue  # a gauge that happens to end in _ms
+            out[name[: -len("_ms")]] = {
+                "count": m.count,
+                "p50_ms": m.percentile_ms(0.50),
+                "p99_ms": m.percentile_ms(0.99),
+            }
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._dropped = 0

@@ -103,20 +103,28 @@ def make_train_step(
         return loss, (metrics, new_stats)
 
     def step(state: TrainState, batch: dict, rng: jax.Array):
-        (loss, (metrics, new_stats)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params, state.batch_stats, batch, rng)
-        new_state = state.apply_gradients(grads, new_batch_stats=new_stats)
-        metrics = dict(metrics)
-        metrics["loss"] = loss
-        metrics["grad_norm"] = optax.global_norm(grads)
+        # jax.named_scope: stage labels in the compiled step's HLO so an
+        # xprof capture splits fwd+bwd / optimizer / sentinel wall time
+        # (docs/OBSERVABILITY.md; staged for the hardware window).
+        with jax.named_scope("train.forward_backward"):
+            (loss, (metrics, new_stats)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params, state.batch_stats, batch, rng)
+        with jax.named_scope("train.optimizer_update"):
+            new_state = state.apply_gradients(
+                grads, new_batch_stats=new_stats
+            )
+            metrics = dict(metrics)
+            metrics["loss"] = loss
+            metrics["grad_norm"] = optax.global_norm(grads)
         if cfg.anomaly_sentinel:  # static flag: one fixed compiled program
             # Divergence sentinel (resilience/anomaly.py): a non-finite or
             # grad-spiking step selects the OLD params/opt_state via
             # jnp.where — fully on device, no host sync, no extra program.
-            new_state, sen_metrics = guard_update(
-                state, new_state, loss, metrics["grad_norm"], cfg
-            )
+            with jax.named_scope("train.sentinel"):
+                new_state, sen_metrics = guard_update(
+                    state, new_state, loss, metrics["grad_norm"], cfg
+                )
             metrics.update(sen_metrics)
         return new_state, metrics
 

@@ -92,6 +92,12 @@ class PreemptionHandler:
             self._restore()
             return
         self._requested = True
+        # Telemetry: the preemption request is a lifecycle event every
+        # later stall diagnosis wants on the timeline (observability/;
+        # host-only, async-signal-cheap: one dict append + counter).
+        from raft_ncup_tpu.observability import get_telemetry
+
+        get_telemetry().event("preemption_signal", signum=int(signum))
         # stderr, not stdout: child stdout is a parsed protocol stream in
         # the test/bench harnesses around the trainer.
         print(

@@ -11,7 +11,11 @@ run end, so a run that survived on retries says so.
 
 Pure stdlib — no jax import: retry wraps host IO only, never device
 work (a failed collective is not retryable; it needs the preemption
-path).
+path). Every retry/giveup/quarantine additionally lands as a telemetry
+ring event + canonical counter (``io_retry_total`` / ``io_giveup_total``
+/ ``io_sample_quarantined_total``; observability/, docs/OBSERVABILITY.md)
+— the log.txt accounting lines and :class:`RetryStats` fields are
+unchanged.
 """
 
 from __future__ import annotations
@@ -20,6 +24,8 @@ import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Tuple, TypeVar
+
+from raft_ncup_tpu.observability import get_telemetry
 
 T = TypeVar("T")
 
@@ -52,7 +58,8 @@ class RetryStats:
             if index in self.quarantined:
                 return False
             self.quarantined.append(index)
-            return True
+        get_telemetry().event("io_sample_quarantined", index=index)
+        return True
 
     @property
     def clean(self) -> bool:
@@ -94,10 +101,14 @@ def retry_io(
             if attempt >= attempts:
                 if stats is not None:
                     stats.note_giveup()
+                get_telemetry().event("io_giveup", desc=desc)
                 raise
             attempt += 1
             if stats is not None:
                 stats.note_retry()
+            get_telemetry().event(
+                "io_retry", desc=desc, attempt=attempt
+            )
             if log is not None:
                 log(
                     f"{desc}: attempt {attempt}/{attempts} failed ({e}); "

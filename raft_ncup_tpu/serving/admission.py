@@ -26,14 +26,29 @@ from raft_ncup_tpu.serving.request import FlowRequest
 
 
 class AdmissionQueue:
-    """Thread-safe bounded FIFO of admitted :class:`FlowRequest`."""
+    """Thread-safe bounded FIFO of admitted :class:`FlowRequest`.
 
-    def __init__(self, capacity: int):
+    With ``telemetry`` bound (observability/; ``name`` is the gauge
+    prefix, e.g. ``serve`` → ``serve_queue_depth``), every ``offer`` /
+    ``pop_batch`` / ``close`` publishes the live depth as a registry
+    gauge (value + peak) — before this, the depth between an offer and
+    the next pop was unobservable from outside, inferable only from
+    shed events once the queue was already full.
+    """
+
+    def __init__(self, capacity: int, *, telemetry=None, name: str = "queue"):
         self.capacity = max(1, int(capacity))
         self._q: deque = deque()
         self._cond = threading.Condition()
         self._closed = False
         self._paused = False
+        self._tel = telemetry
+        self._depth_gauge = f"{name}_queue_depth"
+
+    def _publish_depth(self) -> None:
+        # Callers hold self._cond: len() is the true instantaneous depth.
+        if self._tel is not None:
+            self._tel.gauge_set(self._depth_gauge, len(self._q))
 
     def __len__(self) -> int:
         with self._cond:
@@ -55,6 +70,7 @@ class AdmissionQueue:
             if self._closed or len(self._q) >= self.capacity:
                 return False
             self._q.append(request)
+            self._publish_depth()
             self._cond.notify()
             return True
 
@@ -63,6 +79,7 @@ class AdmissionQueue:
         with self._cond:
             self._closed = True
             self._paused = False
+            self._publish_depth()
             self._cond.notify_all()
 
     def set_paused(self, paused: bool) -> None:
@@ -115,6 +132,7 @@ class AdmissionQueue:
                     and key_fn(self._q[0]) == want
                 ):
                     batch.append(self._q.popleft())
+                self._publish_depth()
                 return batch
             seen = {distinct_fn(head)}
             i = 0
@@ -129,4 +147,5 @@ class AdmissionQueue:
                 del self._q[i]
                 batch.append(req)
                 seen.add(d)
+            self._publish_depth()
             return batch

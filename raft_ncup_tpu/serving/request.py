@@ -22,7 +22,10 @@ server failed":
 thread-safe (submit callers, the dispatcher, and the drain worker all
 mutate it concurrently), mutated only through ``note_*`` methods, and
 rendered into one summary line so a run that survived on shedding and
-quarantine says so.
+quarantine says so. Each ``note_*`` additionally mirrors into the
+telemetry registry under the canonical ``snake_case`` counter name
+(``observability.telemetry.LEGACY_KEY_ALIASES["serve"]`` — the pinned
+alias table); the legacy summary/report keys here never change.
 """
 
 from __future__ import annotations
@@ -31,6 +34,10 @@ import math
 import threading
 from dataclasses import dataclass, field
 from typing import Any, List, Optional, Sequence
+
+from raft_ncup_tpu.observability.telemetry import LEGACY_KEY_ALIASES
+
+_SERVE_CANON = LEGACY_KEY_ALIASES["serve"]
 
 def nearest_rank_ms(latencies_s: Sequence[float], p: float) -> Optional[float]:
     """Nearest-rank percentile of a latency sample, in milliseconds.
@@ -144,36 +151,54 @@ class ServeStats:
     batches: int = 0
     padded_rows: int = 0  # dummy rows added to reach a fixed batch program
     quarantined: List[int] = field(default_factory=list)  # poison request ids
+    # Telemetry hub to mirror into (observability/; None = no mirror).
+    # The local fields above stay the report()/summary() source of truth.
+    telemetry: Optional[Any] = field(default=None, repr=False, compare=False)
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def _mirror(self, field_name: str, delta: int = 1) -> None:
+        # Outside the stats lock: the registry has its own, and holding
+        # both would order them differently on different call paths.
+        if self.telemetry is not None:
+            self.telemetry.inc(_SERVE_CANON[field_name], delta)
 
     def note_submitted(self) -> None:
         with self._lock:
             self.submitted += 1
+        self._mirror("submitted")
 
     def note_accepted(self) -> None:
         with self._lock:
             self.accepted += 1
+        self._mirror("accepted")
 
     def note_completed(self) -> None:
         with self._lock:
             self.completed += 1
+        self._mirror("completed")
 
     def note_shed(self) -> None:
         with self._lock:
             self.shed += 1
+        self._mirror("shed")
 
     def note_timeout(self) -> None:
         with self._lock:
             self.timeouts += 1
+        self._mirror("timeouts")
 
     def note_error(self) -> None:
         with self._lock:
             self.errors += 1
+        self._mirror("errors")
 
     def note_batch(self, padded_rows: int) -> None:
         with self._lock:
             self.batches += 1
             self.padded_rows += padded_rows
+        self._mirror("batches")
+        if padded_rows:
+            self._mirror("padded_rows", padded_rows)
 
     def note_rejected(self, request_id: int, *,
                       quarantine: bool = False) -> None:
@@ -186,6 +211,11 @@ class ServeStats:
             self.rejected += 1
             if quarantine and request_id not in self.quarantined:
                 self.quarantined.append(request_id)
+        self._mirror("rejected")
+        if quarantine and self.telemetry is not None:
+            self.telemetry.event(
+                "serve_request_quarantined", request_id=request_id
+            )
 
     def summary(self) -> str:
         q = ",".join(str(i) for i in self.quarantined) or "-"

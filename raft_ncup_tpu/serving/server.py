@@ -62,6 +62,7 @@ from raft_ncup_tpu.inference.pipeline import (
     DispatchThrottle,
     ShapeCachedForward,
 )
+from raft_ncup_tpu.observability import get_telemetry
 from raft_ncup_tpu.ops.padding import InputPadder
 from raft_ncup_tpu.serving.admission import AdmissionQueue
 from raft_ncup_tpu.serving.budget import IterationBudgetController
@@ -96,10 +97,18 @@ class FlowServer:
         *,
         mesh=None,
         clock: Callable[[], float] = time.monotonic,
+        telemetry=None,
     ):
         self.cfg = cfg or ServeConfig()
         self._clock = clock
-        self.stats = ServeStats()
+        # The telemetry hub (observability/; docs/OBSERVABILITY.md):
+        # stats mirror into its registry under the canonical counter
+        # names, spans trace each batch's queue-wait / assembly /
+        # pad+stage / dispatch / drain stages with request/batch
+        # correlation ids, and report() reads the per-stage p50/p99
+        # back out. None binds the process-wide default hub.
+        self._tel = telemetry if telemetry is not None else get_telemetry()
+        self.stats = ServeStats(telemetry=self._tel)
         # Mesh-first serving (docs/SHARDING.md): an explicit `mesh=`
         # wins; otherwise ServeConfig.mesh = (data, spatial) builds one.
         # Every compiled serving program is then a single SPMD program —
@@ -115,9 +124,11 @@ class FlowServer:
         # the model's own policy (ShapeCachedForward's default).
         self._fwd = ShapeCachedForward(
             model, variables, mesh=mesh, cache_size=self.cfg.cache_size,
-            policy=self.cfg.precision,
+            policy=self.cfg.precision, telemetry=self._tel,
         )
-        self._queue = AdmissionQueue(self.cfg.queue_capacity)
+        self._queue = AdmissionQueue(
+            self.cfg.queue_capacity, telemetry=self._tel, name="serve"
+        )
         self.budget = IterationBudgetController(
             self.cfg.iter_levels,
             capacity=self.cfg.queue_capacity,
@@ -270,53 +281,98 @@ class FlowServer:
                         self.stats.note_error()
 
     def _process(self, batch: list, depth: int) -> None:
-        now = self._clock()
-        live = []
-        for req in batch:
-            if req.deadline is not None and now > req.deadline:
-                self.stats.note_timeout()
-                self._complete(req.request_id, FlowResponse(
-                    req.request_id, STATUS_TIMEOUT,
-                    latency_s=now - req.submit_time,
-                    detail="deadline expired in queue",
-                ))
-                continue
-            poison = self._poison_error(req)
-            if poison is not None:
-                self.stats.note_rejected(req.request_id, quarantine=True)
-                self._complete(req.request_id, FlowResponse(
-                    req.request_id, STATUS_REJECTED, detail=poison,
-                ))
-                continue
-            live.append(req)
-        if not live:
-            return
-        iters = self.budget.decide(depth)
-        ph, pw = live[0].shape_key
-        rows1 = [self._stage(r.image1, r.pad_spec) for r in live]
-        rows2 = [self._stage(r.image2, r.pad_spec) for r in live]
-        n_rows = next(
-            b for b in self.cfg.batch_sizes if b >= len(live)
-        )
-        pad_rows = n_rows - len(live)
-        for _ in range(pad_rows):
-            rows1.append(np.zeros((ph, pw, 3), np.float32))
-            rows2.append(np.zeros((ph, pw, 3), np.float32))
-        self.stats.note_batch(pad_rows)
-        img1 = np.stack(rows1)
-        img2 = np.stack(rows2)
-        t_dispatch = self._clock()
-        _, flow_up = self._fwd.forward_device(img1, img2, iters)
-        self._throttle.push(flow_up)
+        # Batch correlation id, minted up front so every span and event
+        # of this batch's journey carries it (the drain worker reuses it
+        # as the in-flight registry token).
         with self._inflight_lock:
             token = self._inflight_seq
             self._inflight_seq += 1
+        now = self._clock()
+        live = []
+        with self._tel.span(
+            "serve_batch_assembly", batch_id=token, batch_size=len(batch)
+        ):
+            for req in batch:
+                if req.deadline is not None and now > req.deadline:
+                    self.stats.note_timeout()
+                    self._complete(req.request_id, FlowResponse(
+                        req.request_id, STATUS_TIMEOUT,
+                        latency_s=now - req.submit_time,
+                        detail="deadline expired in queue",
+                    ))
+                    continue
+                poison = self._poison_error(req)
+                if poison is not None:
+                    self.stats.note_rejected(
+                        req.request_id, quarantine=True
+                    )
+                    self._complete(req.request_id, FlowResponse(
+                        req.request_id, STATUS_REJECTED, detail=poison,
+                    ))
+                    continue
+                live.append(req)
+        if not live:
+            return
+        # Per-request queue wait (submit -> batch assembly), correlated
+        # to both the request and the batch that finally carried it.
+        for req in live:
+            self._tel.observe_ms(
+                "serve_queue_wait", (now - req.submit_time) * 1e3,
+                request_id=req.request_id, batch_id=token,
+            )
+        iters = self.budget.decide(depth)
+        self._tel.gauge_set("serve_iter_budget", iters)
+        ph, pw = live[0].shape_key
+        with self._tel.span(
+            "serve_pad_stage", batch_id=token, rows=len(live),
+        ) as stage_span:
+            rows1 = [self._stage(r.image1, r.pad_spec) for r in live]
+            rows2 = [self._stage(r.image2, r.pad_spec) for r in live]
+            n_rows = next(
+                b for b in self.cfg.batch_sizes if b >= len(live)
+            )
+            pad_rows = n_rows - len(live)
+            for _ in range(pad_rows):
+                rows1.append(np.zeros((ph, pw, 3), np.float32))
+                rows2.append(np.zeros((ph, pw, 3), np.float32))
+            stage_span.set(pad_rows=pad_rows)
+            img1 = np.stack(rows1)
+            img2 = np.stack(rows2)
+        self.stats.note_batch(pad_rows)
+        t_dispatch = self._clock()
+        # The dispatch span times jit dispatch + the throttle's bounded
+        # wait, NOT device completion (the drain span covers dispatch ->
+        # delivery); it carries the full correlation set — request ids,
+        # batch id, mesh + policy fingerprints.
+        from raft_ncup_tpu.utils.profiling import stage_annotation
+
+        with self._tel.span(
+            "serve_dispatch",
+            batch_id=token,
+            request_ids=[r.request_id for r in live],
+            iters=iters,
+            mesh=self._fwd.mesh_fp,
+            policy=self._fwd.policy.name,
+        ), stage_annotation("serve.dispatch"):
+            _, flow_up = self._fwd.forward_device(img1, img2, iters)
+            self._throttle.push(flow_up)
+        with self._inflight_lock:
             self._inflight[token] = live
 
         def deliver(host_flow, live=live, iters=iters, token=token):
             with self._inflight_lock:
                 self._inflight.pop(token, None)
             done = self._clock()
+            # Dispatch -> delivered: device compute + the sanctioned
+            # drain-worker pull, one per batch. The pull counter is the
+            # independent measurement flip_recommendations checks
+            # against stats.batches for snapshot consistency.
+            self._tel.inc("serve_drain_pulls_total")
+            self._tel.observe_ms(
+                "serve_drain", (done - t_dispatch) * 1e3,
+                batch_id=token,
+                request_ids=[r.request_id for r in live],
+            )
             for k, req in enumerate(live):
                 (t, b), (le, r) = req.pad_spec
                 hh, ww = host_flow.shape[1], host_flow.shape[2]
@@ -379,6 +435,10 @@ class FlowServer:
                 per_pair_s if prev is None
                 else 0.8 * prev + 0.2 * per_pair_s
             )
+            ema = self._service_ema
+        # The live EMA behind retry_after_s, as a gauge: the backpressure
+        # hint's basis is observable instead of inferable from hints.
+        self._tel.gauge_set("serve_service_time_ema_ms", ema * 1e3)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -449,7 +509,17 @@ class FlowServer:
         return self.stats
 
     def report(self) -> dict:
-        """One JSON-able summary: stats + budget + executable accounting."""
+        """One JSON-able summary: stats + budget + executable accounting.
+
+        Every pre-telemetry key survives verbatim (back-compat pinned in
+        tests/test_observability.py); ``stages`` adds the per-stage
+        p50/p99 latency breakdown from the span tracer alongside.
+        """
+        stages = {
+            k: v
+            for k, v in self._tel.tracer.stage_summary().items()
+            if k.startswith("serve_")
+        }
         return {
             "stats": self.stats.summary(),
             "budget": self.budget.summary(),
@@ -458,6 +528,7 @@ class FlowServer:
             "executables": dict(self._fwd.stats),
             "precision": self._fwd.policy.name,  # RESOLVED (None inherits)
             "mesh": self._fwd.mesh_fp,
+            "stages": stages,
         }
 
     def __enter__(self) -> "FlowServer":

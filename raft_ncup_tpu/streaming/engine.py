@@ -65,6 +65,8 @@ from raft_ncup_tpu.inference.pipeline import (
     DispatchThrottle,
     ShapeCachedForward,
 )
+from raft_ncup_tpu.observability import get_telemetry
+from raft_ncup_tpu.observability.telemetry import LEGACY_KEY_ALIASES
 from raft_ncup_tpu.ops.padding import InputPadder
 from raft_ncup_tpu.serving.admission import AdmissionQueue
 from raft_ncup_tpu.serving.request import (
@@ -99,7 +101,10 @@ class FrameRequest:
 @dataclass(eq=False)
 class StreamStats:
     """Per-run streaming accounting (ServeStats' note_*-only discipline:
-    submit callers, the dispatcher, and the drain worker all write)."""
+    submit callers, the dispatcher, and the drain worker all write).
+    Each ``note`` mirrors into the telemetry registry under the
+    canonical counter name (``LEGACY_KEY_ALIASES["stream"]``); the
+    legacy summary keys never change."""
 
     submitted: int = 0
     accepted: int = 0
@@ -115,6 +120,7 @@ class StreamStats:
     streams_closed: int = 0
     streams_evicted: int = 0
     cold_starts: int = 0  # frames dispatched cold (first/gap/reset-next)
+    telemetry: object = field(default=None, repr=False, compare=False)
     _lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False
     )
@@ -122,6 +128,10 @@ class StreamStats:
     def note(self, field_name: str, delta: int = 1) -> None:
         with self._lock:
             setattr(self, field_name, getattr(self, field_name) + delta)
+        if self.telemetry is not None and delta:
+            self.telemetry.inc(
+                LEGACY_KEY_ALIASES["stream"][field_name], delta
+            )
 
     def summary(self) -> str:
         return (
@@ -151,10 +161,15 @@ class StreamEngine:
         *,
         mesh=None,
         clock: Callable[[], float] = time.monotonic,
+        telemetry=None,
     ):
         self.cfg = cfg or StreamConfig()
         self._clock = clock
-        self.stats = StreamStats()
+        # Telemetry hub (observability/): counters mirror under the
+        # canonical names, slot lifecycle (admit/evict/shed/reset) lands
+        # as correlated ring events, spans trace each batch's stages.
+        self._tel = telemetry if telemetry is not None else get_telemetry()
+        self.stats = StreamStats(telemetry=self._tel)
         # Mesh-first streaming (docs/SHARDING.md): an explicit `mesh=`
         # wins; otherwise StreamConfig.mesh = (data, spatial) builds
         # one. The step programs then compile as SPMD — frame batches
@@ -203,9 +218,11 @@ class StreamEngine:
         self._table_lock = threading.Lock()
         self._fwd = ShapeCachedForward(
             model, variables, mesh=mesh, cache_size=self.cfg.cache_size,
-            policy=self._policy,
+            policy=self._policy, telemetry=self._tel,
         )
-        self._queue = AdmissionQueue(self.cfg.queue_capacity)
+        self._queue = AdmissionQueue(
+            self.cfg.queue_capacity, telemetry=self._tel, name="stream"
+        )
         self._throttle = DispatchThrottle(self.cfg.inflight)
         self._drainer = AsyncDrain(depth=self.cfg.drain_depth)
         self.registry = SlotRegistry(self.cfg.capacity)
@@ -274,9 +291,16 @@ class StreamEngine:
                 )
                 for s in evicted:
                     self.stats.note("streams_evicted")
+                    self._tel.event(
+                        "stream_slot_evicted",
+                        stream_id=s.stream_id, slot=s.slot,
+                    )
                 state = self.registry.admit(stream_id, native_hw, now)
                 if state is None:
                     self.stats.note("shed_streams")
+                    self._tel.event(
+                        "stream_slot_shed", stream_id=stream_id
+                    )
                     hint = self.registry.soonest_expiry_s(
                         now, self.cfg.idle_timeout_s
                     )
@@ -287,6 +311,10 @@ class StreamEngine:
                     ))
                     return handle
                 self.stats.note("streams_opened")
+                self._tel.event(
+                    "stream_slot_admitted",
+                    stream_id=stream_id, slot=state.slot,
+                )
             if state.native_hw != native_hw:
                 self.stats.note("rejected")
                 handle.complete(FlowResponse(
@@ -360,8 +388,11 @@ class StreamEngine:
                 return False
             state.closing = True
             if state.pending == 0:
-                self.registry.release(stream_id)
+                slot = self.registry.release(stream_id)
                 self.stats.note("streams_closed")
+                self._tel.event(
+                    "stream_slot_released", stream_id=stream_id, slot=slot
+                )
         return True
 
     def _frame_error(self, image) -> Optional[str]:
@@ -419,8 +450,12 @@ class StreamEngine:
                     evicted = self.registry.evict_expired(
                         self._clock(), self.cfg.idle_timeout_s
                     )
-                for _ in evicted:
+                for s in evicted:
                     self.stats.note("streams_evicted")
+                    self._tel.event(
+                        "stream_slot_evicted",
+                        stream_id=s.stream_id, slot=s.slot,
+                    )
                 continue
             try:
                 self._process(batch)
@@ -463,20 +498,24 @@ class StreamEngine:
             def fn(v, table, img1, img2, slot_idx, cold):
                 # Storage is (possibly) narrow; the warm-start splat is
                 # coordinate arithmetic, so it runs at the policy's
-                # pinned f32 coord dtype.
-                prev_flow = table["flow"][slot_idx].astype(
-                    policy.coord_jnp
-                )  # (B, h8, w8, 2)
-                warm = (
-                    table["warm"][slot_idx] * (1.0 - cold) > 0.5
-                )  # (B,) bool
-                splat = forward_interpolate_batch(
-                    prev_flow, cfg.splat_chunk
-                )
-                finit = jnp.where(
-                    warm[:, None, None, None], splat,
-                    jnp.zeros_like(splat),
-                )
+                # pinned f32 coord dtype. jax.named_scope labels the
+                # step's stages in the compiled HLO for xprof
+                # (docs/OBSERVABILITY.md).
+                with jax.named_scope("stream.slot_gather"):
+                    prev_flow = table["flow"][slot_idx].astype(
+                        policy.coord_jnp
+                    )  # (B, h8, w8, 2)
+                    warm = (
+                        table["warm"][slot_idx] * (1.0 - cold) > 0.5
+                    )  # (B,) bool
+                with jax.named_scope("stream.warmstart_splat"):
+                    splat = forward_interpolate_batch(
+                        prev_flow, cfg.splat_chunk
+                    )
+                    finit = jnp.where(
+                        warm[:, None, None, None], splat,
+                        jnp.zeros_like(splat),
+                    )
                 kwargs = {}
                 if carry_net:
                     kwargs = {
@@ -489,30 +528,31 @@ class StreamEngine:
                 )
                 # In-graph anomaly: a non-finite or diverged row resets
                 # ITS slot to cold; batch-mates' rows are untouched.
-                bad = (
-                    ~jnp.isfinite(flow_lr).all(axis=(1, 2, 3))
-                    | ~jnp.isfinite(flow_up).all(axis=(1, 2, 3))
-                    | (jnp.abs(flow_lr).max(axis=(1, 2, 3)) > thresh)
-                )
-                good = ~bad
-                gm = good[:, None, None, None]
-                new_table = dict(table)
-                # Scatter back at the table's STORAGE dtype (donation
-                # needs matching avals; bf16 presets narrow here).
-                new_flow = jnp.where(
-                    gm, flow_lr, jnp.zeros_like(flow_lr)
-                ).astype(state_dt)
-                new_table["flow"] = table["flow"].at[slot_idx].set(
-                    new_flow
-                )
-                new_table["warm"] = table["warm"].at[slot_idx].set(
-                    good.astype(table["warm"].dtype)
-                )
-                if carry_net:
-                    netf = net_f.astype(state_dt)
-                    new_table["net"] = table["net"].at[slot_idx].set(
-                        jnp.where(gm, netf, jnp.zeros_like(netf))
+                with jax.named_scope("stream.anomaly_scatter"):
+                    bad = (
+                        ~jnp.isfinite(flow_lr).all(axis=(1, 2, 3))
+                        | ~jnp.isfinite(flow_up).all(axis=(1, 2, 3))
+                        | (jnp.abs(flow_lr).max(axis=(1, 2, 3)) > thresh)
                     )
+                    good = ~bad
+                    gm = good[:, None, None, None]
+                    new_table = dict(table)
+                    # Scatter back at the table's STORAGE dtype (donation
+                    # needs matching avals; bf16 presets narrow here).
+                    new_flow = jnp.where(
+                        gm, flow_lr, jnp.zeros_like(flow_lr)
+                    ).astype(state_dt)
+                    new_table["flow"] = table["flow"].at[slot_idx].set(
+                        new_flow
+                    )
+                    new_table["warm"] = table["warm"].at[slot_idx].set(
+                        good.astype(table["warm"].dtype)
+                    )
+                    if carry_net:
+                        netf = net_f.astype(state_dt)
+                        new_table["net"] = table["net"].at[slot_idx].set(
+                            jnp.where(gm, netf, jnp.zeros_like(netf))
+                        )
                 return new_table, flow_up, bad
 
             # Donate the slot table: the step's scatter updates it in
@@ -552,40 +592,71 @@ class StreamEngine:
     def _process(self, batch: list) -> None:
         import jax.numpy as jnp
 
+        # Batch correlation id, minted up front so every span/event of
+        # this batch carries it (doubles as the in-flight token).
+        with self._inflight_lock:
+            token = self._inflight_seq
+            self._inflight_seq += 1
+        now = self._clock()
+        for req in batch:
+            self._tel.observe_ms(
+                "stream_queue_wait", (now - req.submit_time) * 1e3,
+                request_id=req.request_id, stream_id=req.stream_id,
+                batch_id=token,
+            )
         n_rows = next(
             b for b in self.cfg.batch_sizes if b >= len(batch)
         )
         pad_rows = n_rows - len(batch)
-        rows1 = [self._stage(r.image1, r.pad_spec) for r in batch]
-        rows2 = [self._stage(r.image2, r.pad_spec) for r in batch]
-        slot_idx = [r.slot for r in batch]
-        cold = [1.0 if r.cold else 0.0 for r in batch]
-        scratch = self.cfg.capacity
-        for _ in range(pad_rows):
-            rows1.append(np.zeros((self._ph, self._pw, 3), np.float32))
-            rows2.append(np.zeros((self._ph, self._pw, 3), np.float32))
-            slot_idx.append(scratch)
-            cold.append(1.0)
+        with self._tel.span(
+            "stream_pad_stage", batch_id=token, rows=len(batch),
+            pad_rows=pad_rows,
+        ):
+            rows1 = [self._stage(r.image1, r.pad_spec) for r in batch]
+            rows2 = [self._stage(r.image2, r.pad_spec) for r in batch]
+            slot_idx = [r.slot for r in batch]
+            cold = [1.0 if r.cold else 0.0 for r in batch]
+            scratch = self.cfg.capacity
+            for _ in range(pad_rows):
+                rows1.append(
+                    np.zeros((self._ph, self._pw, 3), np.float32)
+                )
+                rows2.append(
+                    np.zeros((self._ph, self._pw, 3), np.float32)
+                )
+                slot_idx.append(scratch)
+                cold.append(1.0)
         self.stats.note("batches")
         self.stats.note("padded_rows", pad_rows)
         with self._reg_lock:
             self._occupancy_sum += self.registry.occupancy
+            self._tel.gauge_set(
+                "stream_slot_occupancy", self.registry.occupancy
+            )
+
+        from raft_ncup_tpu.utils.profiling import stage_annotation
 
         t_dispatch = self._clock()
         step = self._step(n_rows)
-        with self._table_lock:
-            self._table, flow_up, bad = step(
-                self._fwd.variables,
-                self._table,
-                jnp.asarray(np.stack(rows1)),
-                jnp.asarray(np.stack(rows2)),
-                jnp.asarray(np.asarray(slot_idx, np.int32)),
-                jnp.asarray(np.asarray(cold, np.float32)),
-            )
-        self._throttle.push(flow_up)
+        with self._tel.span(
+            "stream_dispatch",
+            batch_id=token,
+            request_ids=[r.request_id for r in batch],
+            stream_ids=[r.stream_id for r in batch],
+            mesh=self._fwd.mesh_fp,
+            policy=self._policy.name,
+        ), stage_annotation("stream.dispatch"):
+            with self._table_lock:
+                self._table, flow_up, bad = step(
+                    self._fwd.variables,
+                    self._table,
+                    jnp.asarray(np.stack(rows1)),
+                    jnp.asarray(np.stack(rows2)),
+                    jnp.asarray(np.asarray(slot_idx, np.int32)),
+                    jnp.asarray(np.asarray(cold, np.float32)),
+                )
+            self._throttle.push(flow_up)
         with self._inflight_lock:
-            token = self._inflight_seq
-            self._inflight_seq += 1
             self._inflight[token] = batch
 
         def deliver(host, batch=batch, token=token):
@@ -593,6 +664,15 @@ class StreamEngine:
                 self._inflight.pop(token, None)
             host_flow, host_bad = host
             done = self._clock()
+            # One sanctioned pull per batch (flow + anomaly flags): the
+            # independent count flip_recommendations checks against the
+            # recorded stream_batches for snapshot consistency.
+            self._tel.inc("stream_drain_pulls_total")
+            self._tel.observe_ms(
+                "stream_drain", (done - t_dispatch) * 1e3,
+                batch_id=token,
+                request_ids=[r.request_id for r in batch],
+            )
             for k, req in enumerate(batch):
                 bad = bool(host_bad[k])
                 if bad:
@@ -624,6 +704,12 @@ class StreamEngine:
                     continue
                 self._finish_frame(req, reset=bad)
                 self.stats.note("resets" if bad else "completed")
+                if bad:
+                    self._tel.event(
+                        "stream_anomaly_reset",
+                        stream_id=req.stream_id, slot=req.slot,
+                        frame_index=req.frame_index, batch_id=token,
+                    )
             self._note_service(
                 (done - t_dispatch) / max(1, len(batch))
             )
@@ -643,8 +729,12 @@ class StreamEngine:
             if reset:
                 state.resets += 1
             if state.closing and state.pending == 0:
-                self.registry.release(req.stream_id)
+                slot = self.registry.release(req.stream_id)
                 self.stats.note("streams_closed")
+                self._tel.event(
+                    "stream_slot_released",
+                    stream_id=req.stream_id, slot=slot,
+                )
 
     def _fail_inflight(self, exc: BaseException) -> None:
         with self._inflight_lock:
@@ -680,6 +770,8 @@ class StreamEngine:
                 per_frame_s if prev is None
                 else 0.8 * prev + 0.2 * per_frame_s
             )
+            ema = self._service_ema
+        self._tel.gauge_set("stream_service_time_ema_ms", ema * 1e3)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -766,6 +858,14 @@ class StreamEngine:
             peak = self.registry.peak_occupancy
             evicted = self.registry.evicted_total
         batches = max(1, self.stats.batches)
+        # Every pre-telemetry key survives verbatim (back-compat pinned
+        # in tests/test_observability.py); `stages` adds the per-stage
+        # p50/p99 breakdown from the span tracer alongside.
+        stages = {
+            k: v
+            for k, v in self._tel.tracer.stage_summary().items()
+            if k.startswith("stream_")
+        }
         return {
             "stats": self.stats.summary(),
             "capacity": self.cfg.capacity,
@@ -776,6 +876,7 @@ class StreamEngine:
             "executables": dict(self._fwd.stats),
             "precision": self._policy.name,  # RESOLVED (None inherits)
             "mesh": self._fwd.mesh_fp,
+            "stages": stages,
         }
 
     def __enter__(self) -> "StreamEngine":

@@ -104,6 +104,18 @@ class Logger:
             self._acc, self._acc_n = {}, 0
             now = time.perf_counter()
             sps = (step + 1 - self._steps_last) / max(now - self._t_last, 1e-9)
+            # Telemetry mirror (observability/): the window means ride
+            # the SAME boundary pull as host floats into gauges — the
+            # training loop's scalars join the one registry every other
+            # subsystem reports to, at zero additional syncs.
+            from raft_ncup_tpu.observability import get_telemetry
+
+            tel = get_telemetry()
+            for k, v in means.items():
+                tel.gauge_set(f"train_{k}", v)
+            tel.gauge_set("train_steps_per_sec", sps)
+            if lr is not None:
+                tel.gauge_set("train_lr", lr)
             self._t_last, self._steps_last = now, step + 1
             parts = [f"[{step + 1:6d}"]
             if lr is not None:

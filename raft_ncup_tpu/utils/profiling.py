@@ -15,6 +15,18 @@ from typing import Callable, Iterator, Optional
 import jax
 
 
+def stage_annotation(name: str):
+    """Host-side xprof stage label: a ``jax.profiler.TraceAnnotation``
+    that shows up on the host-thread timeline of a profiler capture
+    (``trace``/``bench.py --trace_dir``), labeling serve/stream dispatch
+    stages next to the device ops the jitted code's ``jax.named_scope``
+    labels. Constructing it outside an active capture is a few ns — the
+    serving hot path wears it permanently (docs/OBSERVABILITY.md). The
+    host-only telemetry spans (observability/spans.py) deliberately do
+    NOT use this: they must work without jax."""
+    return jax.profiler.TraceAnnotation(name)
+
+
 @contextlib.contextmanager
 def trace(log_dir: Optional[str]) -> Iterator[None]:
     """Capture a device trace into ``log_dir`` (no-op when None).

@@ -36,7 +36,7 @@ def recommend(record: dict) -> list[str]:
             "corr_impl='volume', RAFT_NCUP_NCONV_IMPL='xla' pending TPU data"
         ] + _val_row_lines(record) + _serve_row_lines(record) + _bf16_row_lines(
             record
-        ) + _highres_row_lines(record)
+        ) + _highres_row_lines(record) + _telemetry_lines(record)
 
     corr = {"volume": record.get("value")}
     for tag in ("onthefly", "pallas"):
@@ -102,6 +102,7 @@ def recommend(record: dict) -> list[str]:
     lines.extend(_serve_row_lines(record))
     lines.extend(_bf16_row_lines(record))
     lines.extend(_highres_row_lines(record))
+    lines.extend(_telemetry_lines(record))
 
     nc = record.get("pairs_per_sec_nconv_pallas")
     fell_back = record.get("pairs_per_sec_nconv_pallas_FELL_BACK_TO_XLA")
@@ -338,6 +339,48 @@ def _highres_row_lines(record: dict) -> list[str]:
         "— no mesh flip from CPU data; the row is staged for first "
         "hardware contact"
     ]
+
+
+def _telemetry_lines(record: dict) -> list[str]:
+    """Telemetry snapshot consistency (bench.py serve/stream rows;
+    docs/OBSERVABILITY.md) — absent snapshot fields → no lines (older
+    records predate them); a window whose sanctioned drain-pull counter
+    drifts from its dispatched-batch counter → flagged INCONSISTENT
+    (the two are independent measurements of the same thing: one
+    AsyncDrain pull per dispatched batch — drift means results were
+    delivered outside the sanctioned path, or dropped); equal → a
+    one-line consistency confirmation. The measured observer overhead
+    is also judged against its 3%-of-p50 budget when recorded."""
+    lines = []
+    for prefix in ("serve", "stream"):
+        gets = record.get(f"{prefix}_sanctioned_gets")
+        batches = record.get(f"{prefix}_batches")
+        if gets is None or batches is None:
+            continue  # no telemetry snapshot in this record
+        if gets != batches:
+            lines.append(
+                f"telemetry: {prefix} snapshot INCONSISTENT — "
+                f"{gets} sanctioned drain pull(s) vs {batches} dispatched "
+                "batch(es) in the window; every batch's results must "
+                "ride exactly one sanctioned AsyncDrain device_get, so "
+                f"the drift means the {prefix}_* numbers cover deliveries "
+                "outside the sanctioned path (or dropped batches) — "
+                "explain it (docs/OBSERVABILITY.md) before reading them"
+            )
+        else:
+            lines.append(
+                f"telemetry: {prefix} snapshot consistent "
+                f"({gets} sanctioned pull(s) = {batches} batch(es))"
+            )
+    overhead = record.get("serve_telemetry_overhead_pct")
+    if overhead is not None and overhead > 3.0:
+        lines.append(
+            f"telemetry: serve tracing overhead {overhead:.1f}% of p50 "
+            "EXCEEDS the 3% budget (docs/OBSERVABILITY.md methodology) — "
+            "profile the tracer hot path before keeping tracing-on "
+            "defaults"
+        )
+    return lines
 
 
 def _serve_row_lines(record: dict) -> list[str]:

@@ -39,9 +39,30 @@ Examples:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import sys
 import time
+
+
+@contextlib.contextmanager
+def _telemetry_export(args):
+    """--telemetry_jsonl: periodic bounded-JSONL telemetry snapshots for
+    the run's duration (observability/export.py); no-op without it."""
+    from raft_ncup_tpu.observability import (
+        JsonlSink,
+        PeriodicSnapshot,
+        get_telemetry,
+    )
+
+    if not args.telemetry_jsonl:
+        yield
+        return
+    with JsonlSink(args.telemetry_jsonl) as sink:
+        with PeriodicSnapshot(
+            get_telemetry(), sink, args.telemetry_interval_s
+        ):
+            yield
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -82,6 +103,18 @@ def build_parser() -> argparse.ArgumentParser:
                         help="drive the streaming video engine "
                         "(raft_ncup_tpu/streaming/) instead of the "
                         "request server")
+    parser.add_argument("--report", action="store_true",
+                        help="embed the full telemetry report "
+                        "(observability.telemetry_report(): registry "
+                        "snapshot, per-stage p50/p99, event accounting) "
+                        "in the printed JSON — the same dict bench.py "
+                        "reads")
+    parser.add_argument("--telemetry_jsonl", default=None, metavar="PATH",
+                        help="write periodic telemetry snapshots to this "
+                        "bounded JSONL sink while serving "
+                        "(observability/export.py)")
+    parser.add_argument("--telemetry_interval_s", type=float, default=5.0,
+                        help="snapshot cadence for --telemetry_jsonl")
     parser.add_argument("--n_streams", type=int, default=4,
                         help="[--stream] concurrent synthetic streams")
     parser.add_argument("--frames_per_stream", type=int, default=8,
@@ -134,7 +167,7 @@ def run_stream(args, model, variables) -> int:
         style=args.style,
     )
     t0 = time.monotonic()
-    with PreemptionHandler() as preempt:
+    with _telemetry_export(args), PreemptionHandler() as preempt:
         handles, interrupted = replay_streams(
             engine, traffic, preempt=preempt,
             sigterm_after=chaos.sigterm_after,
@@ -163,6 +196,10 @@ def run_stream(args, model, variables) -> int:
         "errors": stats.errors,
         **engine.report(),
     }
+    if args.report:
+        from raft_ncup_tpu.observability import telemetry_report
+
+        report["telemetry"] = telemetry_report()
     print(json.dumps(report), flush=True)
     if interrupted:
         print(
@@ -226,7 +263,7 @@ def main(argv=None) -> int:
         style=args.style,
     )
     t0 = time.monotonic()
-    with PreemptionHandler() as preempt:
+    with _telemetry_export(args), PreemptionHandler() as preempt:
         handles, interrupted = replay(
             server, traffic, preempt=preempt,
             sigterm_after=chaos.sigterm_after,
@@ -256,6 +293,10 @@ def main(argv=None) -> int:
         "errors": stats.errors,
         **server.report(),
     }
+    if args.report:
+        from raft_ncup_tpu.observability import telemetry_report
+
+        report["telemetry"] = telemetry_report()
     print(json.dumps(report), flush=True)
     if interrupted:
         print(

@@ -418,3 +418,69 @@ class TestBf16Row:
                                     val_loop_recompiles_bf16=0))
         (ln,) = [x for x in lines if x.startswith("bf16:")]
         assert "forward row missing" in ln
+
+
+class TestTelemetryLines:
+    """Telemetry snapshot consistency (bench serve/stream rows,
+    docs/OBSERVABILITY.md): absent -> silent, clean -> one consistency
+    line, drifted -> flagged INCONSISTENT; plus the 3%-of-p50 overhead
+    budget check."""
+
+    def test_absent_snapshot_is_silent(self):
+        lines = flip.recommend(_tpu())
+        assert not any(x.startswith("telemetry:") for x in lines)
+
+    def test_consistent_snapshot_confirms(self):
+        lines = flip.recommend(
+            _tpu(serve_sanctioned_gets=12, serve_batches=12)
+        )
+        (ln,) = [x for x in lines if x.startswith("telemetry:")]
+        assert "consistent" in ln and "12" in ln
+
+    def test_dirty_snapshot_flags_inconsistent(self):
+        lines = flip.recommend(
+            _tpu(serve_sanctioned_gets=11, serve_batches=12)
+        )
+        (ln,) = [x for x in lines if x.startswith("telemetry:")]
+        assert "INCONSISTENT" in ln and "11" in ln and "12" in ln
+
+    def test_stream_snapshot_judged_independently(self):
+        lines = flip.recommend(
+            _tpu(
+                serve_sanctioned_gets=8, serve_batches=8,
+                stream_sanctioned_gets=5, stream_batches=7,
+            )
+        )
+        tl = [x for x in lines if x.startswith("telemetry:")]
+        assert len(tl) == 2
+        assert "serve snapshot consistent" in tl[0]
+        assert "stream snapshot INCONSISTENT" in tl[1]
+
+    def test_overhead_over_budget_is_flagged(self):
+        lines = flip.recommend(
+            _tpu(
+                serve_sanctioned_gets=8, serve_batches=8,
+                serve_telemetry_overhead_pct=4.2,
+            )
+        )
+        tl = [x for x in lines if x.startswith("telemetry:")]
+        assert any("EXCEEDS the 3% budget" in x for x in tl)
+
+    def test_overhead_within_budget_is_quiet(self):
+        lines = flip.recommend(
+            _tpu(
+                serve_sanctioned_gets=8, serve_batches=8,
+                serve_telemetry_overhead_pct=1.1,
+            )
+        )
+        tl = [x for x in lines if x.startswith("telemetry:")]
+        assert not any("EXCEEDS" in x for x in tl)
+
+    def test_cpu_records_also_judged(self):
+        """The snapshot check is backend-independent: a CPU record's
+        early return still carries the telemetry lines."""
+        lines = flip.recommend(
+            {"value": 9.0, "baseline_key": "cpu@host:volume:1x96x128x4",
+             "serve_sanctioned_gets": 3, "serve_batches": 4}
+        )
+        assert any("INCONSISTENT" in x for x in lines)

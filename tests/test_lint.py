@@ -603,6 +603,74 @@ def test_jgl009_sentinel_module_in_scope(tmp_path):
     assert [f.rule for f in findings] == ["JGL009"]
 
 
+# --------------------------------------------------------------- JGL010
+
+
+def test_jgl010_flags_jax_and_pulls_in_observability(tmp_path):
+    """Telemetry is host-only: jax imports, jax.* calls, numpy pulls,
+    and .item()/.tolist() inside observability/ all violate the
+    no-device-access / no-added-sync constraint."""
+    findings = lint_snippet(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        def record(registry, value):
+            host = jax.device_get(value)       # pull inside telemetry
+            arr = np.asarray(value)            # implicit pull
+            scalar = value.item()              # method pull
+            registry.counter("x").inc(host + arr.sum() + scalar)
+        """,
+        name="observability/bad.py",
+        select=["JGL010"],
+    )
+    assert [f.rule for f in findings] == ["JGL010"] * 4
+    # The import finding is module-level; the pulls are inside record().
+    assert "record" in {f.qualname for f in findings}
+
+
+def test_jgl010_from_jax_import_flagged(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        from jax import profiler
+        """,
+        name="observability/spans.py",
+        select=["JGL010"],
+    )
+    assert [f.rule for f in findings] == ["JGL010"]
+
+
+def test_jgl010_host_only_telemetry_is_clean(tmp_path):
+    """The package's real shape — stdlib locks, clocks, math on host
+    scalars — is clean, and the same code outside observability/ is not
+    this rule's business."""
+    clean = """
+        import threading
+        import time
+
+        def observe(hist, seconds):
+            hist.observe_ms(float(seconds) * 1000.0)
+
+        def snapshot(metrics):
+            return {k: m.value for k, m in sorted(metrics.items())}
+        """
+    assert lint_snippet(
+        tmp_path, clean, name="observability/good.py", select=["JGL010"]
+    ) == []
+    pulls_elsewhere = """
+        import jax
+
+        def boundary(x):
+            return jax.device_get(x)  # a producer's sanctioned pull
+        """
+    assert lint_snippet(
+        tmp_path, pulls_elsewhere, name="serving/free.py",
+        select=["JGL010"],
+    ) == []
+
+
 # ------------------------------------------------------------- allowlist
 
 

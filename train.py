@@ -312,6 +312,19 @@ def main(argv=None) -> int:
                 # sanctioned device_get — the steady-state loop stays
                 # sync-free (same contract as the Logger's boundary pull).
                 sen = jax.device_get(state.sentinel)
+                # Telemetry rides the SAME sanctioned pull: host ints
+                # into gauges, never a second sync (observability/).
+                from raft_ncup_tpu.observability import get_telemetry
+
+                tel = get_telemetry()
+                tel.gauge_set("train_sentinel_skipped", int(sen["skipped"]))
+                tel.gauge_set(
+                    "train_sentinel_consecutive", int(sen["consecutive"])
+                )
+                tel.gauge_set(
+                    "train_sentinel_ema_grad_norm",
+                    float(sen["ema_grad_norm"]),
+                )
                 if int(sen["skipped"]):
                     logger.write_text(
                         f"sentinel @ {step_i}: skipped={int(sen['skipped'])} "
@@ -319,6 +332,10 @@ def main(argv=None) -> int:
                         f"ema_grad_norm={float(sen['ema_grad_norm']):.4f}"
                     )
                 if int(sen["consecutive"]) >= train_cfg.sentinel_halt_after:
+                    tel.event(
+                        "train_sentinel_halt", step=step_i,
+                        consecutive=int(sen["consecutive"]),
+                    )
                     halted = True
                     break
             if step_i % train_cfg.val_freq == 0 or step_i == total:
