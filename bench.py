@@ -1038,8 +1038,15 @@ def _measure_serve(
         )
     )
     # Fresh telemetry hub per row: the window's counters/spans are
-    # isolated from the process default and from other rows.
+    # isolated from the process default and from other rows. The
+    # declared serving SLOs ride along (observability/slo.py): the row
+    # stamps their verdict block so flip_recommendations can tell a
+    # clean steady-state window from one that was degraded while the
+    # latencies were measured.
+    from raft_ncup_tpu.observability import SloEngine, serve_slos
+
     tel = Telemetry()
+    tel.slo = SloEngine(serve_slos(), tel)
     server = FlowServer(model, variables, cfg, telemetry=tel)
     try:
         server.warmup((H, W))
@@ -1064,6 +1071,7 @@ def _measure_serve(
             # compares like with like.
             batches_before = server.stats.batches
             pulls_before = tel.counter_value("serve_drain_pulls_total")
+            tel.slo.evaluate()  # baseline sample for the window's burn
             traffic = SyntheticTraffic(
                 (H, W), n, seed=91, interval_s=interval, style="rigid"
             )
@@ -1075,6 +1083,11 @@ def _measure_serve(
             pulls_in_window = int(
                 tel.counter_value("serve_drain_pulls_total") - pulls_before
             )
+            # The window's SLO verdicts + health state, evaluated inside
+            # the guard scope (the evaluation itself must add no sync).
+            tel.slo.evaluate()
+            slo_snap = tel.slo.snapshot()
+            health_state = server.health.state
             stages = server.report()["stages"]
             # Snapshot the window-A health counters BEFORE window B: the
             # record's shed/timeouts/errors/budget_drops must describe
@@ -1141,6 +1154,12 @@ def _measure_serve(
         # Per-stage p50/p99 breakdown from the span tracer (includes
         # warm calibration traffic; the stage shape, not the headline).
         "serve_stages": stages,
+        # Health/SLO verdict block (observability/; docs/OBSERVABILITY.md):
+        # the declared SLO set's verdicts over this window and the
+        # server's final health state — flip_recommendations reads both.
+        "serve_health": health_state,
+        "serve_slo_pages": slo_snap["pages_total"],
+        "serve_slo": slo_snap["verdicts"],
     }
     lat_off = [
         r.latency_s
@@ -1224,7 +1243,13 @@ def _measure_stream(
             corr_impl=corr_impl,
         )
     )
-    tel = Telemetry()  # fresh hub: bench-window isolation
+    # Fresh hub for bench-window isolation, with the declared streaming
+    # SLOs attached so the row stamps their verdict block (see the
+    # serve row).
+    from raft_ncup_tpu.observability import SloEngine, stream_slos
+
+    tel = Telemetry()
+    tel.slo = SloEngine(stream_slos(n_streams), tel)
     engine = StreamEngine(model, variables, cfg, telemetry=tel)
     try:
         engine.warmup()
@@ -1247,6 +1272,7 @@ def _measure_stream(
             # bracket it for the snapshot-consistency check.
             batches_before = engine.stats.batches
             pulls_before = tel.counter_value("stream_drain_pulls_total")
+            tel.slo.evaluate()  # baseline sample for the window's burn
             traffic = StreamTraffic(
                 (H, W), n_streams, frames, seed=93,
                 interval_s=interval, style="rigid",
@@ -1260,6 +1286,9 @@ def _measure_stream(
                 tel.counter_value("stream_drain_pulls_total")
                 - pulls_before
             )
+            tel.slo.evaluate()  # window verdicts, inside the guard scope
+            slo_snap = tel.slo.snapshot()
+            health_state = engine.health.state
         report = engine.report()
     finally:
         engine.drain()
@@ -1295,6 +1324,10 @@ def _measure_stream(
         "stream_batches": batches_in_window,
         "stream_sanctioned_gets": pulls_in_window,
         "stream_stages": report["stages"],
+        # Health/SLO verdict block (see the serve row).
+        "stream_health": health_state,
+        "stream_slo_pages": slo_snap["pages_total"],
+        "stream_slo": slo_snap["verdicts"],
     }
 
 

@@ -142,7 +142,12 @@ def _record_violation(desc: str) -> None:
     # scope-local source of truth.
     from raft_ncup_tpu.observability import get_telemetry
 
-    get_telemetry().event("guard_host_transfer_violation", desc=desc)
+    tel = get_telemetry()
+    tel.event("guard_host_transfer_violation", desc=desc)
+    # Fault trigger (observability/flight.py): a guard violation means
+    # a sync leaked onto the hot path — bank the timeline that led to
+    # it. Rate-limited in the recorder, no-op without one.
+    tel.flight_dump("guard_violation", desc=desc)
     if raise_on_violation:
         raise GuardViolation(
             f"implicit device->host transfer under forbid_host_transfers: "

@@ -1,10 +1,16 @@
 """Unified telemetry subsystem: metrics registry, span tracer, export
-layer (docs/OBSERVABILITY.md).
+layer — and the consumer half that closes the loop: health state
+machine, SLO burn-rate engine, fault flight recorder
+(docs/OBSERVABILITY.md).
 
 One registry, one event stream, every subsystem a producer — serving,
 streaming, inference, and the resilience layer all mirror their
 accounting here without changing a single legacy ``report()`` key
-(``telemetry.LEGACY_KEY_ALIASES`` is the pinned map).
+(``telemetry.LEGACY_KEY_ALIASES`` is the pinned map). The consumers
+read it back at runtime: declared SLOs burn against the registry,
+paging verdicts flip per-subsystem health READY ⇄ DEGRADED and degrade
+the serving tier's anytime iteration budget, and every fault trigger
+banks one bounded atomic flight-recorder dump.
 
 Host-only by construction: nothing in this package may import jax,
 touch a device array, or add a sync — lint rule JGL010 enforces it
@@ -20,6 +26,29 @@ from raft_ncup_tpu.observability.export import (
     prometheus_text,
     set_telemetry,
     telemetry_report,
+    write_healthz,
+)
+from raft_ncup_tpu.observability.flight import (
+    FlightRecorder,
+    load_dump,
+    match_records,
+)
+from raft_ncup_tpu.observability.health import (
+    DEGRADED,
+    DRAINING,
+    HALTED,
+    READY,
+    STARTING,
+    STATE_CODES,
+    WARMING,
+    HealthTracker,
+    overall_state,
+)
+from raft_ncup_tpu.observability.slo import (
+    SloEngine,
+    SloSpec,
+    serve_slos,
+    stream_slos,
 )
 from raft_ncup_tpu.observability.spans import (
     NOOP_SPAN,
@@ -39,19 +68,36 @@ from raft_ncup_tpu.observability.telemetry import (
 __all__ = [
     "Counter",
     "DEFAULT_BUCKETS_MS",
+    "DEGRADED",
+    "DRAINING",
+    "FlightRecorder",
     "Gauge",
+    "HALTED",
+    "HealthTracker",
     "Histogram",
     "JsonlSink",
     "LEGACY_KEY_ALIASES",
     "MetricsRegistry",
     "NOOP_SPAN",
     "PeriodicSnapshot",
+    "READY",
+    "STARTING",
+    "STATE_CODES",
+    "SloEngine",
+    "SloSpec",
     "Span",
     "SpanTracer",
     "Telemetry",
+    "WARMING",
     "get_telemetry",
     "host_number",
+    "load_dump",
+    "match_records",
+    "overall_state",
     "prometheus_text",
+    "serve_slos",
     "set_telemetry",
+    "stream_slos",
     "telemetry_report",
+    "write_healthz",
 ]

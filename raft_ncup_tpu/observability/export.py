@@ -30,6 +30,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from raft_ncup_tpu.observability.flight import FLIGHT_ENV, FlightRecorder
+from raft_ncup_tpu.observability.health import HealthTracker, overall_state
 from raft_ncup_tpu.observability.spans import (
     NOOP_SPAN,
     SpanTracer,
@@ -40,21 +42,39 @@ TELEMETRY_ENV = "RAFT_NCUP_TELEMETRY"
 
 
 class Telemetry:
-    """Registry + tracer behind one enable flag. The facade methods are
-    the ONLY producer API the rest of the codebase uses, so flipping
-    ``enabled`` turns the entire telemetry surface on/off at once."""
+    """Registry + tracer behind one enable flag, plus the consumer half
+    (docs/OBSERVABILITY.md): per-subsystem :class:`HealthTracker`s, an
+    optional attached :class:`~raft_ncup_tpu.observability.slo.SloEngine`
+    (``slo``), and an optional :class:`FlightRecorder` (``flight``). The
+    facade methods are the ONLY producer API the rest of the codebase
+    uses, so flipping ``enabled`` turns the entire telemetry surface
+    on/off at once — health STATE keeps tracking even when disabled (it
+    gates the budget controller and the healthz file: product logic,
+    not just an exported number), but its gauges/events are suppressed
+    like every other producer call."""
 
     def __init__(
         self,
         enabled: bool = True,
         span_capacity: int = 2048,
         clock: Callable[[], float] = time.monotonic,
+        flight_dir: Optional[str] = None,
     ):
         self.registry = MetricsRegistry()
         self.tracer = SpanTracer(
             self.registry, capacity=span_capacity, clock=clock
         )
         self.enabled = bool(enabled)
+        self.clock = clock
+        # Consumer half: health trackers are get-or-create per
+        # subsystem; the SLO engine and flight recorder are attached by
+        # the driver (serve.py/train.py/bench) that knows the specs/dir.
+        self._health: dict = {}
+        self._health_lock = threading.Lock()
+        self.slo = None
+        self.flight = (
+            FlightRecorder(flight_dir) if flight_dir else None
+        )
 
     # ---------------------------------------------------------- producers
 
@@ -70,6 +90,14 @@ class Telemetry:
         if self.enabled:
             self.tracer.observe_ms(name, ms, **attrs)
 
+    def hist_observe(self, name: str, ms) -> None:
+        """Registry-histogram-only observation (no ring record): the
+        per-request end-to-end latency feed — one histogram append per
+        request would be fine, one ring record per request would crowd
+        the batch-level spans out of the flight recorder's window."""
+        if self.enabled:
+            self.registry.histogram(name).observe_ms(ms)
+
     def event(self, name: str, **attrs) -> None:
         if self.enabled:
             self.tracer.event(name, **attrs)
@@ -79,7 +107,43 @@ class Telemetry:
             return self.tracer.span(name, **attrs)
         return NOOP_SPAN
 
-    # ---------------------------------------------------------- consumers
+    # ------------------------------------------------------ consumer half
+
+    def health(self, subsystem: str, fresh: bool = False) -> HealthTracker:
+        """The subsystem's health tracker (created STARTING on first
+        use). One tracker per subsystem per hub — the process's answer
+        to "is this replica healthy". ``fresh=True`` replaces any
+        existing tracker (a re-entrant driver run must start STARTING,
+        not inherit a previous run's terminal HALTED)."""
+        with self._health_lock:
+            tr = self._health.get(subsystem)
+            if tr is None or fresh:
+                tr = HealthTracker(subsystem, telemetry=self,
+                                   clock=self.clock)
+                self._health[subsystem] = tr
+            return tr
+
+    def health_snapshot(self) -> dict:
+        with self._health_lock:
+            trackers = dict(self._health)
+        return {name: tr.snapshot() for name, tr in sorted(
+            trackers.items()
+        )}
+
+    def slo_paging(self, subsystem: Optional[str] = None) -> bool:
+        """Is an attached SLO engine currently paging (for
+        ``subsystem``)? False with no engine — the budget controller's
+        second degrade input degrades to pure queue-depth behavior."""
+        eng = self.slo
+        return False if eng is None else eng.paging(subsystem)
+
+    def flight_dump(self, trigger: str, **context) -> Optional[str]:
+        """Trigger a flight-recorder dump (no-op without a recorder or
+        when the hub is disabled); returns the dump path or None."""
+        rec = self.flight
+        if rec is None or not self.enabled:
+            return None
+        return rec.record(trigger, self, **context)
 
     def counter_value(self, name: str) -> float:
         m = self.registry.get(name)
@@ -99,12 +163,15 @@ _default: Optional[Telemetry] = None
 
 def get_telemetry() -> Telemetry:
     """The process-wide default hub (created on first use; honors
-    ``RAFT_NCUP_TELEMETRY=0``)."""
+    ``RAFT_NCUP_TELEMETRY=0`` and arms the flight recorder when
+    ``RAFT_NCUP_FLIGHT_DIR`` names a directory — the drivers attach one
+    explicitly either way)."""
     global _default
     with _default_lock:
         if _default is None:
             _default = Telemetry(
-                enabled=os.environ.get(TELEMETRY_ENV, "1") != "0"
+                enabled=os.environ.get(TELEMETRY_ENV, "1") != "0",
+                flight_dir=os.environ.get(FLIGHT_ENV) or None,
             )
         return _default
 
@@ -120,15 +187,48 @@ def set_telemetry(tel: Optional[Telemetry]) -> Optional[Telemetry]:
 
 def telemetry_report(tel: Optional[Telemetry] = None) -> dict:
     """The one snapshot dict every consumer reads: full registry
-    snapshot, per-stage latency breakdown, and ring accounting."""
+    snapshot, per-stage latency breakdown, ring accounting — and the
+    consumer half's verdicts: per-subsystem health states and (when an
+    engine is attached) the SLO verdict block."""
     tel = tel or get_telemetry()
-    return {
+    report = {
         "enabled": tel.enabled,
         "metrics": tel.registry.snapshot(),
         "stages": tel.tracer.stage_summary(),
         "spans_recorded": len(tel.tracer.records()),
         "spans_dropped": tel.tracer.dropped,
+        "health": tel.health_snapshot(),
+        "slo": tel.slo.snapshot() if tel.slo is not None else None,
     }
+    if tel.flight is not None:
+        report["flight"] = tel.flight.snapshot()
+    return report
+
+
+def write_healthz(path: str, tel: Optional[Telemetry] = None) -> None:
+    """Atomically rewrite the machine-readable health file a fleet
+    router polls (serve.py ``--healthz_file``): per-subsystem health
+    snapshots, the worst-state headline, the SLO verdict block, and the
+    drain/halt exit contract (DRAINING rides the existing SIGTERM →
+    exit-75 path; HALTED the sentinel → exit-76 one). tmp + ``os.replace``
+    — a poller never reads a torn file."""
+    tel = tel or get_telemetry()
+    health = tel.health_snapshot()
+    payload = {
+        "time_unix_s": round(time.time(), 3),
+        "overall": overall_state(health),
+        "health": health,
+        "slo": tel.slo.snapshot() if tel.slo is not None else None,
+        "draining": any(
+            s["state"] == "draining" for s in health.values()
+        ),
+        "exit_contract": {"draining": 75, "halted": 76},
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh)
+        fh.write("\n")
+    os.replace(tmp, path)
 
 
 def prometheus_text(tel: Optional[Telemetry] = None) -> str:
@@ -188,43 +288,70 @@ class JsonlSink:
 
 
 class PeriodicSnapshot:
-    """Background thread writing ``telemetry_report`` snapshots to a
-    :class:`JsonlSink` every ``interval_s`` (plus one final snapshot at
-    ``stop()``), stamped with wall time — the long-running-server export
-    path (serve.py ``--telemetry_jsonl``)."""
+    """Background thread driving the telemetry cadence every
+    ``interval_s``: evaluate the hub's attached SLO engine (so burn
+    rates stay fresh without a second timer), write a
+    ``telemetry_report`` snapshot to the :class:`JsonlSink`, and rewrite
+    the ``healthz_path`` file when configured — plus one final tick at
+    ``stop()``. The long-running-server export path (serve.py
+    ``--telemetry_jsonl`` / ``--healthz_file``).
+
+    ``sink`` may be None (healthz-only cadence). ``stop()`` before
+    ``start()`` is a no-op: a monitor that never ran has nothing final
+    to report, and writing a "final" snapshot from it would stamp a
+    phantom observation into the sink (regression-pinned in
+    tests/test_observability.py).
+    """
 
     def __init__(
         self,
         tel: Telemetry,
-        sink: JsonlSink,
+        sink: Optional[JsonlSink],
         interval_s: float = 10.0,
+        healthz_path: Optional[str] = None,
     ):
         self._tel = tel
         self._sink = sink
         self._interval = max(0.05, float(interval_s))
+        self._healthz = healthz_path
+        self._started = False
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="telemetry-snapshot", daemon=True
         )
 
     def start(self) -> "PeriodicSnapshot":
+        self._started = True
+        # First tick immediately: the healthz file must exist before the
+        # first interval elapses (a router polling a just-started
+        # replica reads STARTING/WARMING, not ENOENT).
+        self._write_one()
         self._thread.start()
         return self
 
     def _write_one(self) -> None:
-        self._sink.write({
-            "name": "telemetry_snapshot",
-            "time_unix_s": round(time.time(), 3),
-            "report": telemetry_report(self._tel),
-        })
-        self._sink.flush()
+        if self._tel.slo is not None:
+            self._tel.slo.evaluate()
+        if self._sink is not None:
+            self._sink.write({
+                "name": "telemetry_snapshot",
+                "time_unix_s": round(time.time(), 3),
+                "report": telemetry_report(self._tel),
+            })
+            self._sink.flush()
+        if self._healthz:
+            write_healthz(self._healthz, self._tel)
 
     def _run(self) -> None:
         while not self._stop.wait(self._interval):
             self._write_one()
 
     def stop(self) -> None:
-        if self._stop.is_set():
+        """Final tick + teardown. No-op before ``start()`` or after a
+        previous ``stop()``. Callers owning a sink must close it AFTER
+        this returns (final-snapshot → sink-close ordering): the final
+        report of a drained run is the one the postmortem reads."""
+        if not self._started or self._stop.is_set():
             return
         self._stop.set()
         if self._thread.is_alive():

@@ -203,25 +203,15 @@ class SpanTracer:
         (``request_id=12`` matches a batch span's ``request_ids``
         containing 12), so ``tracer.for_attr(request_id=12)``
         reassembles request 12's whole journey: its own queue-wait plus
-        every batch-level stage that carried it."""
-        out = []
-        for r in self.records():
-            attrs = r["attrs"]
-            ok = True
-            for k, v in match.items():
-                got = attrs.get(k)
-                if got == v:
-                    continue
-                if isinstance(got, list) and v in got:
-                    continue
-                plural = attrs.get(k + "s")
-                if isinstance(plural, list) and v in plural:
-                    continue
-                ok = False
-                break
-            if ok:
-                out.append(r)
-        return out
+        every batch-level stage that carried it.
+
+        The matching itself is ``flight.match_records`` — ONE
+        implementation shared with the offline postmortem tool, so the
+        live tracer and a dumped ring can never drift semantically.
+        """
+        from raft_ncup_tpu.observability.flight import match_records
+
+        return match_records(self.records(), **match)
 
     @property
     def dropped(self) -> int:

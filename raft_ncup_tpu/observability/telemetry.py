@@ -47,6 +47,7 @@ inference stack, and this module must stay importable without jax.)
 from __future__ import annotations
 
 import math
+import re
 import threading
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -82,6 +83,31 @@ def host_number(value, what: str = "metric value") -> float:
             "scalar."
         )
     return float(value)
+
+
+_PROM_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+_PROM_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prometheus_name(name: str) -> str:
+    """Sanitize a registry name into the exposition-format charset
+    (``[a-zA-Z_:][a-zA-Z0-9_:]*``): invalid characters become ``_``, a
+    leading digit gets a ``_`` prefix. Registry names follow the
+    snake_case convention and pass through untouched; the sanitizer
+    exists so a free-form span name can never emit a line a real
+    scraper rejects (scrapers fail the WHOLE scrape on one bad line)."""
+    if _PROM_NAME_OK.match(name):
+        return name
+    safe = _PROM_BAD_CHARS.sub("_", name)
+    if not safe or not (safe[0].isalpha() or safe[0] in "_:"):
+        safe = "_" + safe
+    return safe
+
+
+def prometheus_help(text: str) -> str:
+    """Escape HELP text per the exposition format (backslash and
+    newline are the two escaped characters on HELP lines)."""
+    return text.replace("\\", r"\\").replace("\n", r"\n")
 
 
 def nearest_rank_ms(latencies_ms: Sequence[float], p: float) -> Optional[float]:
@@ -306,21 +332,35 @@ class MetricsRegistry:
         return out
 
     def prometheus_text(self) -> str:
-        """Prometheus text exposition of every metric (counters as
-        ``# TYPE c counter``, gauges as gauge + ``_peak``, histograms as
-        cumulative ``_bucket{le=...}`` + ``_sum``/``_count``)."""
+        """Prometheus text exposition of every metric, compliant with
+        the text format a real scraper parses unmodified (pinned by
+        tests/test_observability.py's mini-parser):
+
+        - metric names sanitized to ``[a-zA-Z_:][a-zA-Z0-9_:]*``
+          (:func:`prometheus_name`) — registry names are free-form
+          strings, exposition names are not;
+        - ``# HELP`` text escaped (backslash, newline);
+        - every exposed metric family gets its own ``# TYPE`` line — in
+          particular the gauge's ``_peak`` companion is its own gauge
+          family, not an untyped stray sample;
+        - histograms expose the full ``_bucket{le=...}`` (cumulative,
+          ending at ``le="+Inf"`` == ``_count``) + ``_sum`` + ``_count``
+          triplet.
+        """
         with self._lock:
             items = sorted(self._metrics.items())
         lines: List[str] = []
-        for name, m in items:
+        for raw_name, m in items:
+            name = prometheus_name(raw_name)
             if m.help:
-                lines.append(f"# HELP {name} {m.help}")
+                lines.append(f"# HELP {name} {prometheus_help(m.help)}")
             if isinstance(m, Counter):
                 lines.append(f"# TYPE {name} counter")
                 lines.append(f"{name} {m.value:g}")
             elif isinstance(m, Gauge):
                 lines.append(f"# TYPE {name} gauge")
                 lines.append(f"{name} {m.value:g}")
+                lines.append(f"# TYPE {name}_peak gauge")
                 lines.append(f"{name}_peak {m.peak:g}")
             elif isinstance(m, Histogram):
                 snap = m.snapshot()

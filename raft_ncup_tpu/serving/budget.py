@@ -67,6 +67,7 @@ class IterationBudgetController:
         self._calm = 0  # consecutive at/below-low_water decisions
         self.drops = 0
         self.recoveries = 0
+        self.slo_drops = 0  # drops where the SLO verdict was the cause
         self.decisions: List[int] = [0] * len(levels)  # per-level counts
 
     @property
@@ -78,15 +79,32 @@ class IterationBudgetController:
         """Current budget without making a decision (reporting only)."""
         return self.levels[self._level]
 
-    def decide(self, queue_depth: int) -> int:
-        """One decision: observe ``queue_depth``, maybe move one level,
-        return the iteration budget for the batch being assembled."""
+    def decide(self, queue_depth: int, slo_degraded: bool = False) -> int:
+        """One decision: observe ``queue_depth`` (and the SLO verdict),
+        maybe move one level, return the iteration budget for the batch
+        being assembled.
+
+        ``slo_degraded`` is the second degrade input (observability/slo
+        — docs/OBSERVABILITY.md): a paging burn rate degrades exactly
+        like a high-water occupancy observation, immediately and with
+        the same one-level-per-decision pacing — queue depth says "work
+        is piling up HERE", the SLO verdict says "the objective is
+        burning" (which queue depth alone misses when the damage shows
+        as shed rate or tail latency rather than backlog). Recovery is
+        the same earned-calm path for both: the SLO must stop paging
+        AND occupancy must sit at/below low_water for the patience
+        window.
+        """
         occ = min(1.0, max(0, int(queue_depth)) / self.capacity)
-        if occ >= self.high_water:
+        if occ >= self.high_water or slo_degraded:
             self._calm = 0
             if self._level < len(self.levels) - 1:
                 self._level += 1
                 self.drops += 1
+                if slo_degraded and occ < self.high_water:
+                    # Occupancy alone would NOT have degraded here: this
+                    # drop is the telemetry loop driving the knob.
+                    self.slo_drops += 1
         elif occ <= self.low_water:
             self._calm += 1
             if self._calm >= self.recover_patience and self._level > 0:

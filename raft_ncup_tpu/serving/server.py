@@ -109,6 +109,13 @@ class FlowServer:
         # back out. None binds the process-wide default hub.
         self._tel = telemetry if telemetry is not None else get_telemetry()
         self.stats = ServeStats(telemetry=self._tel)
+        # The machine-readable health answer (observability/health.py;
+        # docs/OBSERVABILITY.md): STARTING here, WARMING/READY through
+        # warmup (or READY at the first completed batch), READY ⇄
+        # DEGRADED driven by the hub's SLO verdicts, DRAINING in
+        # drain() — the exact scrape surface serve.py --healthz_file
+        # exposes to a fleet router.
+        self.health = self._tel.health("serve", fresh=True)
         # Mesh-first serving (docs/SHARDING.md): an explicit `mesh=`
         # wins; otherwise ServeConfig.mesh = (data, spatial) builds one.
         # Every compiled serving program is then a single SPMD program —
@@ -301,10 +308,27 @@ class FlowServer:
                         detail="deadline expired in queue",
                     ))
                     continue
+                # Per-request queue wait (submit -> batch assembly),
+                # correlated to both the request and the batch. Recorded
+                # for every request that reached assembly alive —
+                # including one about to be quarantined, whose journey
+                # the flight recorder must still reassemble.
+                self._tel.observe_ms(
+                    "serve_queue_wait", (now - req.submit_time) * 1e3,
+                    request_id=req.request_id, batch_id=token,
+                )
                 poison = self._poison_error(req)
                 if poison is not None:
                     self.stats.note_rejected(
                         req.request_id, quarantine=True
+                    )
+                    # Fault trigger: the quarantine decision plus the
+                    # recent timeline, banked before the batch-mates'
+                    # dispatch overwrites the ring's oldest entries.
+                    self._tel.flight_dump(
+                        "poison_quarantine",
+                        request_id=req.request_id, batch_id=token,
+                        detail=poison,
                     )
                     self._complete(req.request_id, FlowResponse(
                         req.request_id, STATUS_REJECTED, detail=poison,
@@ -313,14 +337,18 @@ class FlowServer:
                 live.append(req)
         if not live:
             return
-        # Per-request queue wait (submit -> batch assembly), correlated
-        # to both the request and the batch that finally carried it.
-        for req in live:
-            self._tel.observe_ms(
-                "serve_queue_wait", (now - req.submit_time) * 1e3,
-                request_id=req.request_id, batch_id=token,
-            )
-        iters = self.budget.decide(depth)
+        # First assembly of a server that never warmed up: it is
+        # serving, so it is READY. Guarded on the pre-ready states only
+        # — an unconditional ready() here would undo an SLO-driven
+        # DEGRADED on the very next batch.
+        if self.health.state in ("starting", "warming"):
+            self.health.ready("serving")
+        # The budget decision reads BOTH degrade inputs: the queue depth
+        # the dispatcher just observed, and the hub's SLO verdict — the
+        # telemetry loop driving the anytime knob (docs/OBSERVABILITY.md).
+        iters = self.budget.decide(
+            depth, slo_degraded=self._tel.slo_paging("serve")
+        )
         self._tel.gauge_set("serve_iter_budget", iters)
         ph, pw = live[0].shape_key
         with self._tel.span(
@@ -378,6 +406,12 @@ class FlowServer:
                 hh, ww = host_flow.shape[1], host_flow.shape[2]
                 flow = host_flow[k, t: hh - b, le: ww - r, :]
                 self.stats.note_completed()
+                # Per-request end-to-end latency (submit → delivered):
+                # the SLI behind the serve_p99_latency SLO — histogram
+                # only, no ring record (observability/slo.py).
+                self._tel.hist_observe(
+                    "serve_e2e_ms", (done - req.submit_time) * 1e3
+                )
                 self._complete(req.request_id, FlowResponse(
                     req.request_id, STATUS_OK, flow=flow, iters=iters,
                     latency_s=done - req.submit_time,
@@ -451,6 +485,7 @@ class FlowServer:
         """
         import jax
 
+        self.health.warming()
         h, w = size_hw
         padder = InputPadder((int(h), int(w), 3), mode="sintel",
                              divisor=self._pad_divisor,
@@ -463,7 +498,9 @@ class FlowServer:
             for iters in self.cfg.iter_levels:
                 out = self._fwd.forward_device(zeros, zeros, iters)
                 jax.block_until_ready(out)
-        return self._fwd.stats["compiles"] - before
+        compiled = self._fwd.stats["compiles"] - before
+        self.health.ready(f"warmup compiled {compiled} programs")
+        return compiled
 
     def pause(self) -> None:
         """Test/ops hook: stop assembling new batches (in-flight ones
@@ -482,7 +519,11 @@ class FlowServer:
 
     def drain(self, timeout: Optional[float] = None) -> ServeStats:
         """Graceful drain: stop admitting, flush everything admitted,
-        tear down, return the final stats. Idempotent."""
+        tear down, return the final stats. Idempotent. Health goes
+        DRAINING immediately — a healthz poller (the fleet router's
+        scrape) sees it before the flush completes, which is the point:
+        stop routing here NOW (the SIGTERM → exit-75 contract)."""
+        self.health.draining()
         self._draining.set()
         self._queue.close()  # also clears any pause: drain must finish
         if self._thread.is_alive():
@@ -525,10 +566,12 @@ class FlowServer:
             "budget": self.budget.summary(),
             "budget_drops": self.budget.drops,
             "budget_recoveries": self.budget.recoveries,
+            "budget_slo_drops": self.budget.slo_drops,
             "executables": dict(self._fwd.stats),
             "precision": self._fwd.policy.name,  # RESOLVED (None inherits)
             "mesh": self._fwd.mesh_fp,
             "stages": stages,
+            "health": self.health.snapshot(),
         }
 
     def __enter__(self) -> "FlowServer":

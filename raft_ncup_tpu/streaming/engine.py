@@ -170,6 +170,11 @@ class StreamEngine:
         # as correlated ring events, spans trace each batch's stages.
         self._tel = telemetry if telemetry is not None else get_telemetry()
         self.stats = StreamStats(telemetry=self._tel)
+        # Machine-readable health (observability/health.py): STARTING →
+        # WARMING/READY through warmup (or first batch), READY ⇄
+        # DEGRADED via the hub's SLO verdicts, DRAINING in drain() —
+        # the stream half of the serve.py --healthz_file surface.
+        self.health = self._tel.health("stream", fresh=True)
         # Mesh-first streaming (docs/SHARDING.md): an explicit `mesh=`
         # wins; otherwise StreamConfig.mesh = (data, spatial) builds
         # one. The step programs then compile as SPMD — frame batches
@@ -604,6 +609,10 @@ class StreamEngine:
                 request_id=req.request_id, stream_id=req.stream_id,
                 batch_id=token,
             )
+        # First assembly of an engine that never warmed up: serving ⇒
+        # READY (guarded so an SLO-driven DEGRADED is not undone here).
+        if self.health.state in ("starting", "warming"):
+            self.health.ready("serving")
         n_rows = next(
             b for b in self.cfg.batch_sizes if b >= len(batch)
         )
@@ -710,6 +719,22 @@ class StreamEngine:
                         stream_id=req.stream_id, slot=req.slot,
                         frame_index=req.frame_index, batch_id=token,
                     )
+                    # Fault trigger: the reset decision + the recent
+                    # timeline (the corrupted frame's whole journey is
+                    # still in the ring at delivery time).
+                    self._tel.flight_dump(
+                        "stream_anomaly_reset",
+                        stream_id=req.stream_id, slot=req.slot,
+                        frame_index=req.frame_index, batch_id=token,
+                    )
+                else:
+                    # Per-frame end-to-end latency: the SLI behind the
+                    # stream_p99_latency SLO (histogram only, no ring
+                    # record).
+                    self._tel.hist_observe(
+                        "stream_e2e_ms",
+                        (done - req.submit_time) * 1e3,
+                    )
             self._note_service(
                 (done - t_dispatch) / max(1, len(batch))
             )
@@ -785,6 +810,7 @@ class StreamEngine:
         use-after-donate."""
         import jax
 
+        self.health.warming()
         before = self._fwd.stats["compiles"]
         self._queue.set_paused(True)
         try:
@@ -810,7 +836,9 @@ class StreamEngine:
                 jax.block_until_ready((self._table, flow_up, bad))
         finally:
             self._queue.set_paused(False)
-        return self._fwd.stats["compiles"] - before
+        compiled = self._fwd.stats["compiles"] - before
+        self.health.ready(f"warmup compiled {compiled} programs")
+        return compiled
 
     def pause(self) -> None:
         """Test/ops hook: stop assembling new batches (queued and new
@@ -826,7 +854,10 @@ class StreamEngine:
 
     def drain(self, timeout: Optional[float] = None) -> StreamStats:
         """Graceful drain: stop admitting, flush every admitted frame,
-        tear down, return final stats. Idempotent."""
+        tear down, return final stats. Idempotent. Health goes DRAINING
+        immediately (the SIGTERM → exit-75 contract: a healthz poller
+        stops routing streams here before the flush completes)."""
+        self.health.draining()
         self._draining.set()
         self._queue.close()  # clears any pause: drain must finish
         if self._thread.is_alive():
@@ -877,6 +908,7 @@ class StreamEngine:
             "precision": self._policy.name,  # RESOLVED (None inherits)
             "mesh": self._fwd.mesh_fp,
             "stages": stages,
+            "health": self.health.snapshot(),
         }
 
     def __enter__(self) -> "StreamEngine":

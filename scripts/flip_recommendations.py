@@ -380,6 +380,48 @@ def _telemetry_lines(record: dict) -> list[str]:
             "profile the tracer hot path before keeping tracing-on "
             "defaults"
         )
+    lines.extend(_slo_lines(record))
+    return lines
+
+
+def _slo_lines(record: dict) -> list[str]:
+    """Health/SLO verdict block (bench.py serve/stream rows;
+    docs/OBSERVABILITY.md "SLO burn rate") — absent block → no lines
+    (older records predate it); a window whose health ended DEGRADED
+    (or worse) or that paged an SLO → flagged: the latencies were
+    measured while the budget controller was coarsening responses, so
+    they describe a degraded service, not the steady state every other
+    verdict assumes; clean → one confirmation line naming the verdict
+    count."""
+    lines = []
+    for prefix in ("serve", "stream"):
+        health = record.get(f"{prefix}_health")
+        verdicts = record.get(f"{prefix}_slo")
+        if health is None and verdicts is None:
+            continue  # no health/SLO block in this record
+        pages = record.get(f"{prefix}_slo_pages") or 0
+        paging = sorted(
+            name for name, v in (verdicts or {}).items() if v.get("page")
+        )
+        if health not in (None, "ready") or pages or paging:
+            detail = []
+            if health not in (None, "ready"):
+                detail.append(f"health={health}")
+            if pages:
+                detail.append(f"{pages} page(s)")
+            if paging:
+                detail.append("paging: " + ", ".join(paging))
+            lines.append(
+                f"slo: {prefix} window DEGRADED ({'; '.join(detail)}) — "
+                f"the {prefix}_* latencies include coarsened (degraded-"
+                "budget) responses; fix the burn or lower the load and "
+                "rerun bench before reading them as steady state"
+            )
+        else:
+            lines.append(
+                f"slo: {prefix} window clean (health=ready, 0 pages "
+                f"over {len(verdicts or {})} declared SLO(s))"
+            )
     return lines
 
 

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Reassemble a request/stream journey from a flight-recorder dump.
+
+Reads one ``flight_<trigger>_<ts>.json`` (observability/flight.py) and
+prints a human-readable postmortem: the fault header (trigger, context,
+mesh/precision fingerprints, health states, paging SLOs), then the
+correlated timeline — every span and event in the dump's ring that
+carries the chosen correlation id, in ring (arrival) order, using the
+SAME matching semantics as the live ``SpanTracer.for_attr`` (a singular
+``request_id`` matches a batch span's plural ``request_ids`` list, so
+batch-level stages appear in a single request's journey).
+
+The correlation id comes from ``--request_id`` / ``--stream_id`` /
+``--batch_id``, or — the common case — from the dump's own trigger
+context (a ``poison_quarantine`` dump names the quarantined request, a
+``stream_anomaly_reset`` dump the reset stream).
+
+``--telemetry_jsonl`` additionally replays the run's periodic snapshot
+file (serve.py ``--telemetry_jsonl``) as a condensed health/SLO/queue
+timeline around the fault — the slow-timescale context (was the queue
+already deep? had the SLO been burning for three windows?) that the
+bounded span ring cannot hold.
+
+Host-only stdlib by construction, like everything it reads: a
+postmortem must be runnable on a laptop from two files, with no jax and
+no backend.
+
+Usage:
+    python scripts/postmortem.py flight_poison_quarantine_*.json
+    python scripts/postmortem.py dump.json --request_id 12
+    python scripts/postmortem.py dump.json --stream_id s3 \
+        --telemetry_jsonl serve_telemetry.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_ncup_tpu.observability.flight import (  # noqa: E402
+    load_dump,
+    match_records,
+)
+
+# Context keys that can seed the correlation when no flag is given, in
+# preference order (a request id is the most specific journey).
+_CONTEXT_KEYS = ("request_id", "stream_id", "batch_id")
+
+
+def _pick_correlation(args, context: dict) -> dict:
+    explicit = {
+        k: v
+        for k, v in (
+            ("request_id", args.request_id),
+            ("stream_id", args.stream_id),
+            ("batch_id", args.batch_id),
+        )
+        if v is not None
+    }
+    if explicit:
+        return explicit
+    for key in _CONTEXT_KEYS:
+        if key in context:
+            return {key: context[key]}
+    return {}
+
+
+def _fmt_attrs(attrs: dict, skip=()) -> str:
+    parts = []
+    for k in sorted(attrs):
+        if k in skip:
+            continue
+        v = attrs[k]
+        if isinstance(v, list) and len(v) > 6:
+            v = f"[{len(v)} items]"
+        parts.append(f"{k}={v}")
+    return " ".join(parts)
+
+
+def _print_journey(records, match: dict) -> int:
+    matched = match_records(records, **match) if match else records
+    label = (
+        " ".join(f"{k}={v}" for k, v in match.items())
+        if match else "full ring (no correlation id)"
+    )
+    print(f"journey [{label}]: {len(matched)} record(s)")
+    for r in matched:
+        kind = "event" if r.get("event") else "span "
+        dur = r.get("duration_ms")
+        dur_s = f"{dur:9.3f} ms" if dur is not None else "         --"
+        print(f"  {kind} {dur_s}  {r['name']:<28} "
+              f"{_fmt_attrs(r.get('attrs', {}))}")
+    return len(matched)
+
+
+def _print_snapshot_timeline(path: str, subsystems) -> None:
+    print(f"\nsnapshot timeline ({path}):")
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("name") != "telemetry_snapshot":
+                continue
+            rep = rec.get("report", {})
+            gauges = rep.get("metrics", {}).get("gauges", {})
+            health = rep.get("health", {}) or {}
+            slo = rep.get("slo") or {}
+            states = " ".join(
+                f"{name}={snap.get('state')}"
+                for name, snap in sorted(health.items())
+                if not subsystems or name in subsystems
+            )
+            depths = " ".join(
+                f"{k}={v.get('value'):g}"
+                for k, v in sorted(gauges.items())
+                if k.endswith("_queue_depth")
+            )
+            paging = ",".join(slo.get("paging", [])) or "-"
+            print(
+                f"  t={rec.get('time_unix_s')}  {states or 'health=-'}  "
+                f"{depths}  paging={paging}"
+            )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Reassemble a request/stream journey from a "
+        "flight-recorder dump"
+    )
+    parser.add_argument("dump", help="flight_<trigger>_<ts>.json path")
+    parser.add_argument("--request_id", type=int, default=None)
+    parser.add_argument("--stream_id", default=None)
+    parser.add_argument("--batch_id", type=int, default=None)
+    parser.add_argument("--telemetry_jsonl", default=None,
+                        help="serve.py --telemetry_jsonl file: print the "
+                        "condensed health/SLO/queue timeline around the "
+                        "fault")
+    args = parser.parse_args(argv)
+
+    dump = load_dump(args.dump)
+    context = dump.get("context", {})
+    print(f"flight dump: {os.path.basename(args.dump)}")
+    print(f"  trigger:      {dump['trigger']}")
+    print(f"  time_unix_s:  {dump.get('time_unix_s')}")
+    if context:
+        print(f"  context:      {_fmt_attrs(context)}")
+    fps = dump.get("fingerprints") or {}
+    if fps:
+        print(f"  fingerprints: {_fmt_attrs(fps)}")
+    report = dump.get("report") or {}
+    health = report.get("health") or {}
+    for name, snap in sorted(health.items()):
+        print(
+            f"  health:       {name}={snap.get('state')} "
+            f"({snap.get('reason', '')})"
+        )
+    slo = report.get("slo") or {}
+    for name, v in sorted((slo.get("verdicts") or {}).items()):
+        if v.get("page"):
+            print(
+                f"  slo PAGING:   {name} burn_fast={v.get('burn_fast')} "
+                f"burn_slow={v.get('burn_slow')}"
+            )
+    print()
+    match = _pick_correlation(args, context)
+    n = _print_journey(dump.get("spans", []), match)
+    if args.telemetry_jsonl:
+        _print_snapshot_timeline(
+            args.telemetry_jsonl, set(health) or None
+        )
+    if n == 0:
+        print("no records matched — wrong id, or the journey aged out "
+              "of the bounded ring before the dump", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,28 +41,66 @@ from __future__ import annotations
 import argparse
 import contextlib
 import json
+import os
 import sys
 import time
 
 
 @contextlib.contextmanager
 def _telemetry_export(args):
-    """--telemetry_jsonl: periodic bounded-JSONL telemetry snapshots for
-    the run's duration (observability/export.py); no-op without it."""
+    """The periodic telemetry cadence for the run's duration: SLO
+    burn-rate evaluation (ALWAYS — the budget controller's second
+    degrade input and the report's verdict block are only truthful if
+    the attached engine actually evaluates during the run, flags or
+    not), plus bounded-JSONL snapshots (--telemetry_jsonl) and the
+    atomically-rewritten healthz file (--healthz_file) when asked.
+
+    Teardown order is the satellite contract: the PeriodicSnapshot's
+    final tick (inner context) runs BEFORE the sink closes (outer), so
+    the last report — the one describing the drained end state — can
+    never hit a closed sink.
+    """
     from raft_ncup_tpu.observability import (
         JsonlSink,
         PeriodicSnapshot,
         get_telemetry,
     )
 
-    if not args.telemetry_jsonl:
+    with contextlib.ExitStack() as stack:
+        sink = None
+        if args.telemetry_jsonl:
+            sink = stack.enter_context(JsonlSink(args.telemetry_jsonl))
+        stack.enter_context(PeriodicSnapshot(
+            get_telemetry(), sink, args.telemetry_interval_s,
+            healthz_path=args.healthz_file,
+        ))
         yield
-        return
-    with JsonlSink(args.telemetry_jsonl) as sink:
-        with PeriodicSnapshot(
-            get_telemetry(), sink, args.telemetry_interval_s
-        ):
-            yield
+
+
+def _attach_observability(args, *, stream: bool):
+    """Arm the consumer half on the process hub (docs/OBSERVABILITY.md):
+    the declared SLO set (serve or stream — evaluated on the snapshot
+    cadence, read by the budget controller and the healthz file) and
+    the fault flight recorder. Returns the hub."""
+    from raft_ncup_tpu.observability import (
+        FlightRecorder,
+        SloEngine,
+        get_telemetry,
+        serve_slos,
+        stream_slos,
+    )
+
+    tel = get_telemetry()
+    if args.flight_dir:
+        tel.flight = FlightRecorder(args.flight_dir)
+    specs = (
+        stream_slos(args.stream_capacity,
+                    window_scale=args.slo_window_scale)
+        if stream
+        else serve_slos(window_scale=args.slo_window_scale)
+    )
+    tel.slo = SloEngine(specs, tel)
+    return tel
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -114,7 +152,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "bounded JSONL sink while serving "
                         "(observability/export.py)")
     parser.add_argument("--telemetry_interval_s", type=float, default=5.0,
-                        help="snapshot cadence for --telemetry_jsonl")
+                        help="snapshot cadence for --telemetry_jsonl / "
+                        "--healthz_file (also the SLO burn-rate "
+                        "evaluation cadence)")
+    parser.add_argument("--healthz_file", default=None, metavar="PATH",
+                        help="atomically rewrite this JSON file on the "
+                        "telemetry cadence with per-subsystem health "
+                        "states + SLO verdicts — the scrape surface a "
+                        "fleet router polls (DRAINING rides the "
+                        "SIGTERM/exit-75 contract; "
+                        "docs/OBSERVABILITY.md)")
+    parser.add_argument("--flight_dir",
+                        default=os.environ.get(
+                            "RAFT_NCUP_FLIGHT_DIR", "flight_recorder"
+                        ),
+                        help="fault flight-recorder directory: every "
+                        "fault trigger (poison quarantine, anomaly "
+                        "reset, SIGTERM drain, SLO page...) banks one "
+                        "bounded atomic flight_<trigger>_<ts>.json "
+                        "here ('' disables; scripts/postmortem.py "
+                        "reads them)")
+    parser.add_argument("--slo_window_scale", type=float, default=1.0,
+                        help="scale the declared SLOs' 5m/1h burn-rate "
+                        "windows (observability/slo.py) — e.g. 0.01 "
+                        "for a seconds-scale demo/bench window")
     parser.add_argument("--n_streams", type=int, default=4,
                         help="[--stream] concurrent synthetic streams")
     parser.add_argument("--frames_per_stream", type=int, default=8,
@@ -146,6 +207,7 @@ def run_stream(args, model, variables) -> int:
     size_hw = (args.size[0], args.size[1])
     stream_cfg = stream_config_from_args(args, size_hw)
 
+    tel = _attach_observability(args, stream=True)
     engine = StreamEngine(model, variables, stream_cfg)
     t0 = time.monotonic()
     compiled = engine.warmup()
@@ -173,6 +235,14 @@ def run_stream(args, model, variables) -> int:
             sigterm_after=chaos.sigterm_after,
         )
         stats = engine.drain()
+        if interrupted:
+            # Fault trigger: the SIGTERM drain (exit 75), banked after
+            # the flush so the dump describes the drained end state.
+            tel.flight_dump(
+                "preemption_drain",
+                completed=stats.completed,
+                shed_frames=stats.shed_frames,
+            )
     wall = time.monotonic() - t0
 
     responses = [h.result(timeout=30.0) for h in handles]
@@ -195,6 +265,7 @@ def run_stream(args, model, variables) -> int:
         "shed_frames": stats.shed_frames,
         "errors": stats.errors,
         **engine.report(),
+        "slo": tel.slo.snapshot() if tel.slo is not None else None,
     }
     if args.report:
         from raft_ncup_tpu.observability import telemetry_report
@@ -242,6 +313,7 @@ def main(argv=None) -> int:
 
     size_hw = (args.size[0], args.size[1])
 
+    tel = _attach_observability(args, stream=False)
     server = FlowServer(model, variables, serve_cfg)
     t0 = time.monotonic()
     compiled = server.warmup(size_hw)
@@ -269,6 +341,13 @@ def main(argv=None) -> int:
             sigterm_after=chaos.sigterm_after,
         )
         stats = server.drain()
+        if interrupted:
+            # Fault trigger: the SIGTERM drain (exit 75), banked after
+            # the flush so the dump describes the drained end state.
+            tel.flight_dump(
+                "preemption_drain",
+                completed=stats.completed, shed=stats.shed,
+            )
     wall = time.monotonic() - t0
 
     responses = [h.result(timeout=30.0) for h in handles]
@@ -292,6 +371,7 @@ def main(argv=None) -> int:
         "rejected": stats.rejected,
         "errors": stats.errors,
         **server.report(),
+        "slo": tel.slo.snapshot() if tel.slo is not None else None,
     }
     if args.report:
         from raft_ncup_tpu.observability import telemetry_report

@@ -63,6 +63,24 @@ def _log(tmp_path, name) -> str:
     return (tmp_path / "checkpoints" / name / "log.txt").read_text()
 
 
+def _flight_dumps(tmp_path, name) -> list:
+    """Flight-recorder dumps a run left under its run dir (sorted)."""
+    d = tmp_path / "checkpoints" / name / "flight"
+    return sorted(os.listdir(d)) if d.exists() else []
+
+
+def _postmortem(argv):
+    """Run scripts/postmortem.py in-process; returns its exit code."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "postmortem", os.path.join(REPO, "scripts", "postmortem.py")
+    )
+    pm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pm)
+    return pm.main(argv)
+
+
 def _trajectory(log: str) -> dict:
     """step -> the summary line's metric portion. The it/s field is
     wall-clock (never reproducible); everything after it — the loss and
@@ -99,6 +117,19 @@ def test_kill_resume_bitwise_identical_trajectory(tmp_path):
     assert (run_dir / "4").exists()  # the one atomic preemption save
     assert (run_dir / "resume_meta.json").exists()
     assert "preempted @ 4" in _log(tmp_path, "killed")
+    # The clean solo run left NO flight dumps; the preempted run left
+    # exactly ONE, for the drain trigger, naming the saved step
+    # (observability/flight.py; docs/OBSERVABILITY.md trigger matrix).
+    assert _flight_dumps(tmp_path, "solo") == []
+    dumps = _flight_dumps(tmp_path, "killed")
+    assert len(dumps) == 1 and dumps[0].startswith(
+        "flight_preemption_drain_"
+    )
+    import json as _json
+
+    dump = _json.load(open(run_dir / "flight" / dumps[0]))
+    assert dump["context"] == {"step": 4, "checkpoint_step": 4}
+    assert dump["report"]["health"]["train"]["state"] == "draining"
 
     rc = _run(
         tmp_path, "killed",
@@ -107,6 +138,8 @@ def test_kill_resume_bitwise_identical_trajectory(tmp_path):
     assert rc == 0
     log_resumed = _log(tmp_path, "killed")
     assert "restored step 4" in log_resumed
+    # The clean resume added no dump: still exactly one.
+    assert _flight_dumps(tmp_path, "killed") == dumps
 
     solo, resumed = _trajectory(log_solo), _trajectory(log_resumed)
     assert set(range(1, 8)) <= set(solo)
@@ -157,6 +190,39 @@ def test_consecutive_bad_steps_halt_and_roll_back(tmp_path):
     # directory beyond the last boundary save.
     steps = sorted(int(d) for d in os.listdir(run_dir) if d.isdigit())
     assert steps[-1] == 4
+
+
+def test_sentinel_halt_leaves_one_flight_dump_postmortem_reads(
+    tmp_path, capsys
+):
+    """The rc-76 half of the flight-recorder acceptance: a sentinel-halt
+    run leaves EXACTLY one valid dump (trigger sentinel_halt, health
+    train=halted, the halt's step/consecutive context), and
+    scripts/postmortem.py reassembles the fault's timeline from it —
+    the train_sentinel_halt event is on the printed journey."""
+    nan = ",".join(f"nan@{s}" for s in range(2, 8))
+    rc = _run(
+        tmp_path, "halted",
+        ["--num_steps", "10", "--val_freq", "100",
+         "--sentinel_halt_after", "3", "--chaos", nan],
+    )
+    assert rc == EXIT_DIVERGED
+    dumps = _flight_dumps(tmp_path, "halted")
+    assert len(dumps) == 1 and dumps[0].startswith(
+        "flight_sentinel_halt_"
+    )
+    path = str(tmp_path / "checkpoints" / "halted" / "flight" / dumps[0])
+    import json as _json
+
+    dump = _json.load(open(path))
+    assert dump["context"]["consecutive"] >= 3
+    assert dump["report"]["health"]["train"]["state"] == "halted"
+    capsys.readouterr()
+    assert _postmortem([path]) == 0
+    out = capsys.readouterr().out
+    assert "trigger:      sentinel_halt" in out
+    assert "train=halted" in out
+    assert "train_sentinel_halt" in out  # the halt event on the journey
 
 
 @pytest.mark.slow

@@ -406,3 +406,68 @@ def test_validate_synthetic_spatial_mesh_matches():
     mesh = make_mesh(data=1, spatial=2, devices=jax.devices()[:2])
     out = validate_synthetic(model, variables, mesh=mesh, **kwargs)
     np.testing.assert_allclose(out["synthetic"], ref["synthetic"], rtol=1e-4)
+
+
+class TestServeDriver:
+    def test_sigterm_drain_leaves_one_flight_dump_and_healthz(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        """The rc-75 half of the flight-recorder acceptance through the
+        REAL driver: a serve.py run SIGTERMed mid-stream drains (exit
+        75), leaves EXACTLY one valid preemption_drain dump, rewrites
+        the --healthz_file to draining, and scripts/postmortem.py
+        reassembles a served request's full span journey (queue wait →
+        dispatch → drain) from the dump."""
+        import importlib.util
+        import json
+
+        import serve as serve_driver
+        from raft_ncup_tpu.observability import get_telemetry, set_telemetry
+
+        flight = tmp_path / "flight"
+        healthz = tmp_path / "healthz.json"
+        # The driver arms the PROCESS hub; isolate it from other tests.
+        prev = set_telemetry(None)
+        try:
+            rc = serve_driver.main([
+                "--platform", "cpu",
+                "--small",
+                "--num_requests", "8",
+                "--size", "48", "64",
+                "--iter_levels", "2,1",
+                "--serve_batch_sizes", "1,2",
+                "--chaos", "sigterm@3",
+                "--flight_dir", str(flight),
+                "--healthz_file", str(healthz),
+                "--telemetry_interval_s", "0.5",
+            ])
+        finally:
+            tel = get_telemetry()
+            tel.flight = None
+            tel.slo = None
+            set_telemetry(prev)
+        assert rc == 75  # EXIT_PREEMPTED: the SIGTERM/exit-75 contract
+        out = capsys.readouterr().out
+        report = json.loads(out.strip().splitlines()[-1])
+        assert report["interrupted"] is True
+        assert report["health"]["state"] == "draining"
+        assert "slo" in report
+        hz = json.load(open(healthz))
+        assert hz["draining"] is True and hz["overall"] == "draining"
+        dumps = sorted(os.listdir(flight))
+        assert len(dumps) == 1 and dumps[0].startswith(
+            "flight_preemption_drain_"
+        )
+        spec = importlib.util.spec_from_file_location(
+            "postmortem",
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "scripts", "postmortem.py",
+            ),
+        )
+        pm = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pm)
+        assert pm.main([str(flight / dumps[0]), "--request_id", "0"]) == 0
+        journey = capsys.readouterr().out
+        for stage in ("serve_queue_wait", "serve_dispatch", "serve_drain"):
+            assert stage in journey  # the request's full span journey

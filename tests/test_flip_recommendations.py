@@ -484,3 +484,65 @@ class TestTelemetryLines:
              "serve_sanctioned_gets": 3, "serve_batches": 4}
         )
         assert any("INCONSISTENT" in x for x in lines)
+
+
+class TestSloBlock:
+    """Health/SLO verdict block (bench.py serve/stream rows;
+    docs/OBSERVABILITY.md): absent block → silent; a DEGRADED window or
+    any page → flagged (the latencies include coarsened responses);
+    clean → one confirmation line."""
+
+    def _verdicts(self, page=False):
+        return {
+            "serve_shed_rate": {"page": page, "burn_fast": 33.0 if page
+                                else 0.0, "burn_slow": 33.0 if page
+                                else 0.0},
+            "serve_error_rate": {"page": False, "burn_fast": 0.0,
+                                 "burn_slow": 0.0},
+        }
+
+    def test_absent_block_adds_no_lines(self):
+        assert flip._slo_lines({"serve_pairs_per_sec": 8.5}) == []
+
+    def test_clean_block_confirms_once(self):
+        lines = flip._slo_lines({
+            "serve_health": "ready", "serve_slo_pages": 0,
+            "serve_slo": self._verdicts(),
+        })
+        assert len(lines) == 1
+        assert "clean" in lines[0] and "2 declared SLO(s)" in lines[0]
+
+    def test_degraded_health_flags_the_window(self):
+        lines = flip._slo_lines({
+            "serve_health": "degraded", "serve_slo_pages": 0,
+            "serve_slo": self._verdicts(),
+        })
+        assert len(lines) == 1 and "DEGRADED" in lines[0]
+        assert "health=degraded" in lines[0]
+
+    def test_pages_flag_the_window_and_name_the_slo(self):
+        lines = flip._slo_lines({
+            "serve_health": "ready", "serve_slo_pages": 1,
+            "serve_slo": self._verdicts(page=True),
+        })
+        assert len(lines) == 1 and "DEGRADED" in lines[0]
+        assert "serve_shed_rate" in lines[0]
+
+    def test_stream_block_reported_independently(self):
+        lines = flip._slo_lines({
+            "serve_health": "ready", "serve_slo_pages": 0,
+            "serve_slo": self._verdicts(),
+            "stream_health": "degraded", "stream_slo_pages": 2,
+            "stream_slo": {},
+        })
+        assert len(lines) == 2
+        assert "serve window clean" in lines[0]
+        assert "stream window DEGRADED" in lines[1]
+
+    def test_slo_block_rides_cpu_records_too(self):
+        lines = flip.recommend({
+            "value": 9.0, "baseline_key": "cpu@host:volume:1x96x128x4",
+            "serve_health": "ready", "serve_slo_pages": 0,
+            "serve_slo": self._verdicts(),
+        })
+        assert any("slo: serve window clean" in l for l in lines)

@@ -671,6 +671,51 @@ def test_jgl010_host_only_telemetry_is_clean(tmp_path):
     ) == []
 
 
+@pytest.mark.parametrize(
+    "module", ["health.py", "slo.py", "flight.py"]
+)
+def test_jgl010_covers_the_consumer_half_modules(tmp_path, module):
+    """The PR 12 consumer modules (health state machine, SLO burn-rate
+    engine, flight recorder) sit under the same host-only contract as
+    the producers: a jax import or device pull inside any of them is a
+    finding, and their real shapes (stdlib state machines, counter
+    deltas, atomic JSON writes) are clean. Zero allowlist entries."""
+    dirty = """
+        import jax
+
+        def evaluate(registry, value):
+            return float(jax.device_get(value))  # sync inside telemetry
+        """
+    findings = lint_snippet(
+        tmp_path, dirty, name=f"observability/{module}",
+        select=["JGL010"],
+    )
+    assert [f.rule for f in findings] == ["JGL010"] * 2
+    clean = """
+        import json
+        import os
+        import time
+
+        ALLOWED = {"ready": {"degraded", "draining"}}
+
+        def transition(state, to):
+            return to if to in ALLOWED.get(state, set()) else state
+
+        def burn(bad, total, budget):
+            return (bad / total) / budget if total else 0.0
+
+        def atomic_write(path, payload):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(payload, fh)
+            os.replace(tmp, path)
+        """
+    assert lint_snippet(
+        tmp_path, clean, name=f"observability/{module}",
+        select=["JGL010"],
+    ) == []
+
+
 # ------------------------------------------------------------- allowlist
 
 

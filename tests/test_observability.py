@@ -8,6 +8,7 @@ enabled.
 """
 
 import json
+import os
 import threading
 import time
 from types import SimpleNamespace
@@ -20,14 +21,31 @@ import pytest
 from raft_ncup_tpu.config import ServeConfig, StreamConfig, small_model_config
 from raft_ncup_tpu.models.raft import RAFT
 from raft_ncup_tpu.observability import (
+    DEGRADED,
+    DRAINING,
+    HALTED,
+    READY,
+    STARTING,
+    STATE_CODES,
+    WARMING,
+    FlightRecorder,
+    HealthTracker,
     JsonlSink,
     LEGACY_KEY_ALIASES,
     MetricsRegistry,
     PeriodicSnapshot,
+    SloEngine,
+    SloSpec,
     SpanTracer,
     Telemetry,
     host_number,
+    load_dump,
+    match_records,
+    overall_state,
+    serve_slos,
+    stream_slos,
     telemetry_report,
+    write_healthz,
 )
 from raft_ncup_tpu.observability.telemetry import Histogram
 from raft_ncup_tpu.serving import AdmissionQueue, FlowServer
@@ -624,3 +642,702 @@ class TestGuardAndLoggerMirrors:
             assert reg.get("train_steps_per_sec").value > 0
         finally:
             set_telemetry(prev)
+
+
+# ------------------------------------------------ health state machine
+
+
+class TestHealthStateMachine:
+    def test_lifecycle_path_and_codes(self):
+        tel = Telemetry()
+        h = HealthTracker("serve", telemetry=tel)
+        assert h.state == STARTING
+        assert h.warming() and h.state == WARMING
+        assert h.ready("warmup done") and h.state == READY
+        assert h.degrade("slo burning") and h.state == DEGRADED
+        assert h.ready("slo recovered") and h.state == READY
+        assert h.draining() and h.state == DRAINING
+        assert h.halted("fatal") and h.state == HALTED
+        snap = h.snapshot()
+        assert snap["state"] == HALTED
+        assert snap["code"] == STATE_CODES[HALTED] == 5
+        assert snap["transitions"] == 6
+        # Transitions published as gauge + correlated events.
+        assert tel.registry.get("serve_health_state").value == 5
+        recs = tel.tracer.records("serve_health_transition")
+        assert [r["attrs"]["to_state"] for r in recs] == [
+            WARMING, READY, DEGRADED, READY, DRAINING, HALTED,
+        ]
+
+    def test_illegal_transitions_are_counted_noops_never_raise(self):
+        tel = Telemetry()
+        h = HealthTracker("x", telemetry=tel)
+        assert not h.degrade("no")  # STARTING -> DEGRADED illegal
+        assert h.state == STARTING
+        h.draining()
+        assert not h.ready("no")  # DRAINING -> READY illegal
+        h.halted("end")
+        assert not h.draining()  # HALTED is terminal
+        assert h.snapshot()["invalid_transitions"] == 3
+        assert tel.counter_value("x_health_invalid_transition_total") == 3
+
+    def test_same_state_is_silent_noop(self):
+        h = HealthTracker("x")
+        h.draining()
+        assert not h.draining()  # drain() is idempotent upstream
+        assert h.snapshot()["transitions"] == 1
+
+    def test_unknown_state_raises(self):
+        with pytest.raises(ValueError, match="unknown health state"):
+            HealthTracker("x").to("broken")
+
+    def test_state_tracks_even_when_hub_disabled(self):
+        """Health is product logic (budget gate, healthz): the STATE
+        machine runs with telemetry off; only the exports are muted."""
+        tel = Telemetry(enabled=False)
+        h = tel.health("serve")
+        h.warming(), h.ready()
+        assert h.state == READY
+        assert tel.tracer.records() == []
+        assert tel.registry.names() == []
+
+    def test_hub_accessor_get_or_create_and_fresh(self):
+        tel = Telemetry()
+        a = tel.health("serve")
+        assert tel.health("serve") is a
+        a.draining()
+        b = tel.health("serve", fresh=True)  # re-entrant driver run
+        assert b is not a and b.state == STARTING
+        assert tel.health_snapshot()["serve"]["state"] == STARTING
+
+    def test_overall_state_is_worst(self):
+        assert overall_state({}) == READY
+        assert overall_state({
+            "serve": {"state": READY}, "stream": {"state": DEGRADED},
+        }) == DEGRADED
+        assert overall_state({
+            "serve": {"state": STARTING}, "train": {"state": HALTED},
+        }) == HALTED
+
+
+# ------------------------------------------------------ slo burn engine
+
+
+def _clocked(start=0.0):
+    t = {"now": float(start)}
+
+    def clk():
+        return t["now"]
+
+    return t, clk
+
+
+class TestSloSpec:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="objective"):
+            SloSpec("a", "serve", "ratio", objective=1.0,
+                    bad="b", total="t")
+        with pytest.raises(ValueError, match="sli"):
+            SloSpec("a", "serve", "nope", objective=0.9)
+        with pytest.raises(ValueError, match="metric fields"):
+            SloSpec("a", "serve", "ratio", objective=0.9, bad="b")
+        with pytest.raises(ValueError, match="fast_window_s"):
+            SloSpec("a", "serve", "gauge", objective=0.9, gauge="g",
+                    max_value=1, fast_window_s=10, slow_window_s=5)
+
+    def test_scaled_shrinks_windows_only(self):
+        s = serve_slos(window_scale=0.01)[0]
+        assert s.fast_window_s == pytest.approx(3.0)
+        assert s.slow_window_s == pytest.approx(36.0)
+        assert s.objective == serve_slos()[0].objective
+
+
+class TestSloEngine:
+    def _engine(self, spec, tel, clk):
+        return SloEngine([spec], tel, clock=clk)
+
+    def test_ratio_burn_math_is_exact(self):
+        t, clk = _clocked()
+        tel = Telemetry(clock=clk)
+        spec = SloSpec("shed", "serve", "ratio", objective=0.9,
+                       bad="bad_total", total="all_total",
+                       fast_window_s=10, slow_window_s=60,
+                       page_burn=2.0, min_events=1)
+        eng = self._engine(spec, tel, clk)
+        eng.evaluate()  # baseline
+        tel.inc("all_total", 10)
+        tel.inc("bad_total", 5)
+        t["now"] = 1.0
+        v = eng.evaluate()["shed"]
+        # bad fraction 0.5 over budget 0.1 => burn 5.0, both windows.
+        assert v.burn_fast == pytest.approx(5.0)
+        assert v.burn_slow == pytest.approx(5.0)
+        assert v.page and eng.paging("serve") and eng.paging()
+
+    def test_min_events_gates_paging(self):
+        t, clk = _clocked()
+        tel = Telemetry(clock=clk)
+        spec = SloSpec("shed", "serve", "ratio", objective=0.9,
+                       bad="bad_total", total="all_total",
+                       fast_window_s=10, slow_window_s=60,
+                       page_burn=2.0, min_events=8)
+        eng = self._engine(spec, tel, clk)
+        eng.evaluate()
+        tel.inc("all_total", 2)
+        tel.inc("bad_total", 2)  # 100% bad, but only 2 events
+        t["now"] = 1.0
+        assert not eng.evaluate()["shed"].page
+
+    def test_page_requires_both_windows(self):
+        """The multi-window discipline: an old burst still inside the
+        slow window but outside the fast one must NOT page."""
+        t, clk = _clocked()
+        tel = Telemetry(clock=clk)
+        spec = SloSpec("shed", "serve", "ratio", objective=0.9,
+                       bad="bad_total", total="all_total",
+                       fast_window_s=3, slow_window_s=60,
+                       page_burn=2.0, min_events=1)
+        eng = self._engine(spec, tel, clk)
+        eng.evaluate()
+        tel.inc("all_total", 10)
+        tel.inc("bad_total", 10)
+        t["now"] = 1.0
+        assert eng.evaluate()["shed"].page  # fresh burst: pages
+        t["now"] = 30.0  # burst now outside fast window, inside slow
+        v = eng.evaluate()["shed"]
+        assert v.burn_fast == 0.0 and v.burn_slow > 2.0
+        assert not v.page
+
+    def test_latency_sli_counts_over_threshold_fraction(self):
+        t, clk = _clocked()
+        tel = Telemetry(clock=clk)
+        spec = SloSpec("p99", "serve", "latency", objective=0.5,
+                       histogram="e2e_ms", threshold_ms=100.0,
+                       fast_window_s=10, slow_window_s=60,
+                       page_burn=1.5, min_events=1)
+        eng = self._engine(spec, tel, clk)
+        eng.evaluate()
+        for _ in range(10):
+            tel.hist_observe("e2e_ms", 50.0)  # <= 100: good
+        for _ in range(10):
+            tel.hist_observe("e2e_ms", 500.0)  # > 100: bad
+        t["now"] = 1.0
+        v = eng.evaluate()["p99"]
+        assert v.bad_fraction_fast == pytest.approx(0.5)
+        assert v.burn_fast == pytest.approx(1.0)  # 0.5 / budget 0.5
+        assert not v.page  # burn 1.0 < page_burn 1.5
+
+    def test_gauge_sli_fraction_of_bad_samples(self):
+        t, clk = _clocked()
+        tel = Telemetry(clock=clk)
+        spec = SloSpec("occ", "stream", "gauge", objective=0.5,
+                       gauge="occupancy", max_value=3.0,
+                       fast_window_s=10, slow_window_s=60,
+                       page_burn=1.9, min_events=2)
+        eng = self._engine(spec, tel, clk)
+        for i, val in enumerate([4, 4, 4, 4]):
+            tel.gauge_set("occupancy", val)
+            t["now"] = float(i)
+            eng.evaluate()
+        v = eng.verdicts()["occ"]
+        assert v.bad_fraction_fast == 1.0
+        assert v.burn_fast == pytest.approx(2.0)
+        assert v.page
+
+    def test_page_edge_flips_health_and_clear_restores(self):
+        t, clk = _clocked()
+        tel = Telemetry(clock=clk)
+        tel.health("serve").ready("test")
+        spec = SloSpec("shed", "serve", "ratio", objective=0.9,
+                       bad="bad_total", total="all_total",
+                       fast_window_s=3, slow_window_s=30,
+                       page_burn=2.0, min_events=1)
+        eng = self._engine(spec, tel, clk)
+        tel.slo = eng
+        eng.evaluate()
+        tel.inc("all_total", 10)
+        tel.inc("bad_total", 10)
+        t["now"] = 1.0
+        eng.evaluate()
+        assert tel.health("serve").state == DEGRADED
+        assert tel.counter_value("slo_page_total") == 1
+        assert tel.slo_paging("serve") and not tel.slo_paging("stream")
+        # Burn gauges published for the scrape surface.
+        assert tel.registry.get("slo_shed_burn_fast").value > 2.0
+        t["now"] = 60.0  # everything aged out of both windows
+        eng.evaluate()
+        assert tel.health("serve").state == READY
+        assert tel.counter_value("slo_clear_total") == 1
+        assert not tel.slo_paging("serve")
+        snap = eng.snapshot()
+        assert snap["paging"] == [] and snap["pages_total"] == 1
+        assert json.loads(json.dumps(snap)) == snap
+
+    def test_no_engine_means_no_paging(self):
+        assert not Telemetry().slo_paging("serve")
+
+
+# ------------------------------------------------------ flight recorder
+
+
+class TestFlightRecorder:
+    def _hub(self, tmp_path, **kw):
+        tel = Telemetry()
+        tel.flight = FlightRecorder(
+            str(tmp_path / "flight"), min_interval_s=0.0, **kw
+        )
+        return tel
+
+    def test_dump_contains_ring_report_and_fingerprints(self, tmp_path):
+        tel = self._hub(tmp_path)
+        tel.health("serve").ready("test")
+        with tel.span("serve_dispatch", batch_id=3, request_ids=[7, 8],
+                      mesh="mesh(d1s2)", policy="bf16_infer"):
+            pass
+        tel.event("serve_request_quarantined", request_id=7)
+        path = tel.flight_dump("poison_quarantine", request_id=7,
+                               batch_id=3, detail="nan in image1")
+        assert path and path.endswith(".json")
+        assert not [p for p in os.listdir(tmp_path / "flight")
+                    if p.endswith(".tmp")]  # atomic rename, no residue
+        dump = load_dump(path)
+        assert dump["trigger"] == "poison_quarantine"
+        assert dump["context"]["request_id"] == 7
+        assert dump["fingerprints"] == {
+            "mesh": "mesh(d1s2)", "policy": "bf16_infer",
+        }
+        assert dump["report"]["health"]["serve"]["state"] == READY
+        journey = match_records(dump["spans"], request_id=7)
+        assert {r["name"] for r in journey} == {
+            "serve_dispatch", "serve_request_quarantined",
+        }
+        assert tel.counter_value("flight_dump_total") == 1
+
+    def test_rate_limit_suppresses_and_counts(self, tmp_path):
+        tel = Telemetry()
+        tel.flight = FlightRecorder(
+            str(tmp_path / "flight"), min_interval_s=100.0
+        )
+        assert tel.flight_dump("poison_quarantine") is not None
+        assert tel.flight_dump("poison_quarantine") is None  # limited
+        assert tel.flight_dump("slo_page") is not None  # per-trigger
+        assert tel.flight.suppressed == 1
+        assert tel.counter_value("flight_dump_suppressed_total") == 1
+
+    def test_dump_cap_deletes_oldest(self, tmp_path):
+        tel = self._hub(tmp_path, max_dumps=2)
+        for i in range(4):
+            assert tel.flight_dump("guard_violation", i=i)
+        names = sorted(os.listdir(tmp_path / "flight"))
+        assert len(names) == 2
+        kept = [load_dump(str(tmp_path / "flight" / n))["context"]["i"]
+                for n in names]
+        assert kept == [2, 3]
+
+    def test_disabled_hub_and_absent_recorder_are_noops(self, tmp_path):
+        assert Telemetry().flight_dump("x") is None
+        tel = self._hub(tmp_path)
+        tel.enabled = False
+        assert tel.flight_dump("x") is None
+        assert not (tmp_path / "flight").exists()
+
+    def test_load_dump_rejects_foreign_json(self, tmp_path):
+        p = tmp_path / "not_a_dump.json"
+        p.write_text('{"hello": 1}')
+        with pytest.raises(ValueError, match="not a flight-recorder"):
+            load_dump(str(p))
+
+    def test_match_records_parity_with_for_attr(self):
+        """The offline matcher and the live tracer must agree — the
+        postmortem tool reads dumps with match_records."""
+        tel = Telemetry()
+        tel.event("a", request_ids=[1, 2], batch_id=9)
+        tel.event("b", request_id=1)
+        tel.event("c", request_id=3)
+        recs = tel.tracer.records()
+        assert match_records(recs, request_id=1) == tel.tracer.for_attr(
+            request_id=1
+        )
+        assert match_records(recs, batch_id=9) == tel.tracer.for_attr(
+            batch_id=9
+        )
+
+
+# --------------------------------------- periodic snapshot lifecycle
+
+
+class TestPeriodicSnapshotLifecycle:
+    def test_stop_before_start_is_noop(self, tmp_path):
+        """The satellite fix: stop() on a never-started monitor must not
+        write a phantom 'final' snapshot."""
+        path = str(tmp_path / "snap.jsonl")
+        with JsonlSink(path) as sink:
+            snap = PeriodicSnapshot(Telemetry(), sink, interval_s=5.0)
+            snap.stop()  # never started
+            assert sink.write({"probe": 1})  # sink untouched and open
+        lines = [json.loads(l) for l in open(path, encoding="utf-8")]
+        assert lines == [{"probe": 1}]
+
+    def test_teardown_orders_final_snapshot_before_sink_close(
+        self, tmp_path
+    ):
+        """The serve.py teardown contract: the final stop() snapshot —
+        the one describing the drained end state — lands in the sink
+        BEFORE it closes (nested contexts, inner exits first)."""
+        path = str(tmp_path / "snap.jsonl")
+        tel = Telemetry()
+        with JsonlSink(path) as sink:
+            with PeriodicSnapshot(tel, sink, interval_s=60.0):
+                tel.inc("late_fact_total", 7)  # only the final tick sees it
+        lines = [json.loads(l) for l in open(path, encoding="utf-8")]
+        snaps = [l for l in lines if l.get("name") == "telemetry_snapshot"]
+        assert len(snaps) >= 2  # immediate start tick + final stop tick
+        assert snaps[-1]["report"]["metrics"]["counters"][
+            "late_fact_total"
+        ] == 7  # the final snapshot was WRITTEN, not dropped on a closed sink
+
+    def test_healthz_written_immediately_and_atomically(self, tmp_path):
+        path = str(tmp_path / "healthz.json")
+        tel = Telemetry()
+        tel.health("serve").ready("test")
+        snap = PeriodicSnapshot(tel, None, interval_s=60.0,
+                                healthz_path=path)
+        snap.start()
+        hz = json.load(open(path, encoding="utf-8"))
+        assert hz["overall"] == READY and not hz["draining"]
+        assert hz["exit_contract"] == {"draining": 75, "halted": 76}
+        tel.health("serve").draining()
+        snap.stop()
+        hz = json.load(open(path, encoding="utf-8"))
+        assert hz["overall"] == DRAINING and hz["draining"]
+        assert not os.path.exists(path + ".tmp")
+
+    def test_snapshot_tick_evaluates_attached_slo(self, tmp_path):
+        tel = Telemetry()
+        tel.slo = SloEngine(serve_slos(), tel)
+        with PeriodicSnapshot(tel, None, interval_s=60.0):
+            pass
+        assert set(tel.slo.snapshot()["verdicts"]) == {
+            s.name for s in serve_slos()
+        }
+
+    def test_write_healthz_direct(self, tmp_path):
+        path = str(tmp_path / "hz.json")
+        tel = Telemetry()
+        write_healthz(path, tel)
+        hz = json.load(open(path, encoding="utf-8"))
+        assert hz["health"] == {} and hz["slo"] is None
+
+
+# -------------------------------------------- prometheus compliance
+
+
+_SAMPLE_RE = None
+
+
+class TestPrometheusCompliance:
+    """A mini-parser pinning the exposition format a real scraper
+    ingests unmodified: name charset, TYPE lines for every family,
+    histogram bucket/sum/count triplet with cumulative +Inf."""
+
+    def _parse(self, text):
+        import re
+
+        name_re = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+        sample_re = re.compile(
+            r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+            r'(\{le="[^"]+"\})? '
+            r"(-?[0-9.eE+]+|\+Inf|NaN)$"
+        )
+        types, samples = {}, []
+        for line in text.splitlines():
+            if not line:
+                continue
+            if line.startswith("# TYPE "):
+                _, _, name, kind = line.split(" ", 3)
+                assert name_re.match(name), line
+                assert kind in ("counter", "gauge", "histogram"), line
+                assert name not in types, f"duplicate TYPE: {line}"
+                types[name] = kind
+            elif line.startswith("# HELP "):
+                assert "\n" not in line
+            else:
+                m = sample_re.match(line)
+                assert m, f"malformed sample line: {line!r}"
+                samples.append((m.group(1), m.group(2), m.group(3)))
+        return types, samples
+
+    def _family(self, name, types):
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base and types.get(base) == "histogram":
+                return base
+        return name
+
+    def test_every_sample_has_a_typed_family(self):
+        reg = MetricsRegistry()
+        reg.counter("serve_requests_shed_total", help="shed requests").inc(2)
+        reg.gauge("serve_queue_depth").set(5)
+        reg.histogram("serve_drain_ms").observe_ms(3.0)
+        reg.histogram("serve_drain_ms").observe_ms(7000.0)
+        types, samples = self._parse(reg.prometheus_text())
+        assert samples, "no samples emitted"
+        for name, _, _ in samples:
+            fam = self._family(name, types)
+            assert fam in types, f"untyped family for sample {name}"
+        # The gauge's peak companion is its own typed gauge family.
+        assert types["serve_queue_depth_peak"] == "gauge"
+
+    def test_histogram_triplet_cumulative_plus_inf(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("x_ms")
+        for ms in (0.5, 3.0, 3.0, 250.0, 99999.0):
+            h.observe_ms(ms)
+        types, samples = self._parse(reg.prometheus_text())
+        buckets = [
+            (label, float(v)) for name, label, v in samples
+            if name == "x_ms_bucket"
+        ]
+        counts = [v for _, v in buckets]
+        assert counts == sorted(counts), "buckets must be cumulative"
+        assert buckets[-1][0] == '{le="+Inf"}'
+        count = next(
+            float(v) for name, _, v in samples if name == "x_ms_count"
+        )
+        assert buckets[-1][1] == count == 5
+        assert any(name == "x_ms_sum" for name, _, _ in samples)
+
+    def test_names_sanitized_to_exposition_charset(self):
+        reg = MetricsRegistry()
+        reg.counter("serve queue.depth-total").inc()
+        reg.counter("0starts_with_digit").inc()
+        types, samples = self._parse(reg.prometheus_text())
+        names = {n for n, _, _ in samples}
+        assert "serve_queue_depth_total" in names
+        assert "_0starts_with_digit" in names
+
+    def test_help_text_escaped_to_one_line(self):
+        reg = MetricsRegistry()
+        reg.counter("c_total", help="line one\nline two \\ backslash")
+        text = reg.prometheus_text()
+        self._parse(text)  # no malformed lines
+        assert r"line one\nline two \\ backslash" in text
+
+
+# ------------------------------------------- the closed loop, end to end
+
+
+class TestClosedLoop:
+    def test_chaos_burst_poison_drives_degrade_then_recovery(
+        self, tmp_path
+    ):
+        """The tentpole acceptance trajectory, deterministic end to end:
+        a burst past queue capacity (sheds) plus a poison request drive
+        the declared shed-rate SLO into burn -> the page edge flips
+        health READY -> DEGRADED and arms the budget controller's second
+        degrade input -> the controller walks down the level set (at
+        least one drop attributable to the SLO alone, occupancy below
+        high water) -> the burst ages out of both burn windows -> the
+        clear edge restores READY -> sustained calm recovers the budget
+        level by level. Exact state and level trajectories asserted;
+        the slo_page and poison_quarantine faults each left a flight
+        dump."""
+        t, clk = _clocked()
+        tel = Telemetry(clock=clk)
+        tel.flight = FlightRecorder(
+            str(tmp_path / "flight"), min_interval_s=0.0
+        )
+        tel.slo = SloEngine(serve_slos(window_scale=0.01), tel, clock=clk)
+        cfg = _cfg(
+            queue_capacity=8, batch_sizes=(1, 2),
+            iter_levels=(8, 4, 2), high_water=1.0, low_water=0.25,
+            recover_patience=2,
+        )
+        srv = FlowServer(_DummyModel(), {}, cfg, telemetry=tel)
+        try:
+            srv.warmup((24, 32))
+            assert srv.health.state == READY
+            tel.slo.evaluate()  # baseline sample at t=0
+
+            # ---- burst + poison: 12 submits against capacity 8 ------
+            srv.pause()
+            poison = _img(5)
+            poison[3, 3, 0] = np.nan
+            handles = []
+            for i in range(12):
+                img = poison if i == 7 else _img(10 + i)
+                handles.append(srv.submit(img, _img(30 + i)))
+            assert srv.stats.shed == 4  # 12 offered, capacity 8
+            t["now"] = 1.0
+            verdicts = tel.slo.evaluate()
+            # shed fraction 4/12 over budget 0.01 -> burn ~33x: page.
+            assert verdicts["serve_shed_rate"].page
+            assert srv.health.state == DEGRADED
+
+            # ---- degraded dispatch: the SLO drives the knob ---------
+            srv.resume()
+            responses = [h.result(60) for h in handles]
+        finally:
+            srv.drain()
+        ok = [r for r in responses if r.status == STATUS_OK]
+        rejected = [r for r in responses if r.status == "rejected"]
+        assert len(ok) == 7 and len(rejected) == 1  # poison quarantined
+        # 4 batches of 2: level 0 -> 1 (occupancy at full queue, paging)
+        # -> 2 (paging ALONE: occupancy already back under high water)
+        # -> floor. Per-batch budgets land in the responses.
+        assert sorted(r.iters for r in ok) == [2, 2, 2, 2, 2, 4, 4]
+        assert srv.budget.level == 2
+        assert srv.budget.drops == 2
+        assert srv.budget.slo_drops >= 1  # telemetry drove the knob
+        assert srv.report()["budget_slo_drops"] == srv.budget.slo_drops
+
+        # ---- recovery: burn windows drain, then earned calm ---------
+        t["now"] = 60.0  # past the scaled slow window
+        tel.slo.evaluate()
+        assert not tel.slo_paging("serve")
+        assert srv.health.state == DRAINING  # drain() above ran already
+
+        # Re-run the recovery phase on a fresh server sharing the hub's
+        # (now clean) SLO verdicts: four calm single-request decisions
+        # recover 2 levels with patience 2.
+        srv2 = FlowServer(_DummyModel(), {}, cfg, telemetry=tel)
+        try:
+            srv2.warmup((24, 32))
+            srv2.budget._level = 2  # resume from the degraded level
+            iters_seen = []
+            for i in range(4):
+                r = srv2.submit(_img(70 + i), _img(80 + i)).result(60)
+                assert r.ok
+                iters_seen.append(r.iters)
+        finally:
+            srv2.drain()
+        assert iters_seen == [2, 4, 4, 8]
+        assert srv2.budget.recoveries == 2 and srv2.budget.level == 0
+
+        # ---- health trajectory + flight evidence --------------------
+        transitions = [
+            (h["from"], h["to"]) for h in srv.health.history()
+        ]
+        assert transitions == [
+            (STARTING, WARMING),
+            (WARMING, READY),
+            (READY, DEGRADED),
+            (DEGRADED, DRAINING),
+        ]
+        dumps = sorted(os.listdir(tmp_path / "flight"))
+        assert sum("slo_page" in d for d in dumps) == 1
+        assert sum("poison_quarantine" in d for d in dumps) == 1
+        # The poison dump reassembles the faulting request's journey.
+        poison_dump = next(
+            d for d in dumps if "poison_quarantine" in d
+        )
+        dump = load_dump(str(tmp_path / "flight" / poison_dump))
+        assert dump["context"]["request_id"] == 7
+        journey = match_records(dump["spans"], request_id=7)
+        assert "serve_queue_wait" in {r["name"] for r in journey}
+
+
+# ------------------- guarded window with the full consumer half armed
+
+
+class TestConsumersPreserveInvariants:
+    def test_guarded_window_with_health_slo_flight_enabled(
+        self, tiny_model, forbid_host_transfers, max_recompiles,
+        tmp_path,
+    ):
+        """The tentpole's standing constraint extended to the consumer
+        half: with health tracking, the SLO engine (evaluated INSIDE the
+        guarded window), and the flight recorder all armed, a warm
+        steady-state serving window still performs ZERO implicit host
+        pulls and ZERO compiles, with exactly one sanctioned get per
+        batch — the closed loop observes and decides without ever
+        touching the device."""
+        model, variables = tiny_model
+        tel = Telemetry()
+        tel.flight = FlightRecorder(str(tmp_path / "flight"))
+        tel.slo = SloEngine(serve_slos(), tel)
+        cfg = _cfg(batch_sizes=(1,), iter_levels=(2, 1))
+        srv = FlowServer(model, variables, cfg, telemetry=tel)
+        try:
+            srv.warmup((40, 48))
+            warm = srv.submit(_img(30, (40, 48)), _img(31, (40, 48)))
+            assert warm.result(120).ok
+            tel.slo.evaluate()  # baseline
+            with forbid_host_transfers() as stats, max_recompiles(0):
+                handles = [
+                    srv.submit(_img(40 + i, (40, 48)),
+                               _img(50 + i, (40, 48)))
+                    for i in range(3)
+                ]
+                rs = [h.result(120) for h in handles]
+                verdicts = tel.slo.evaluate()  # burn math inside guards
+        finally:
+            srv.drain()
+        assert [r.status for r in rs] == [STATUS_OK] * 3
+        assert stats.host_transfers == 0
+        assert stats.sanctioned_gets == 3  # one per batch, unchanged
+        assert srv.health.state == DRAINING  # via drain(); READY inside
+        assert not any(v.page for v in verdicts.values())
+        # No fault triggered: the recorder stayed quiet.
+        assert tel.flight.dumps == 0
+        # e2e latency histogram fed the latency SLI without a ring record.
+        assert tel.registry.get("serve_e2e_ms").count >= 3
+        rep = telemetry_report(tel)
+        assert rep["health"]["serve"]["state"] == DRAINING
+        assert rep["slo"]["verdicts"]
+
+
+class TestSloEngineReviewRegressions:
+    def test_gauge_occupancy_slo_can_actually_page(self):
+        """Review regression: a gauge SLI saturates at bad_fraction 1.0,
+        so its max burn is 1/(1-objective) — the declared occupancy SLO
+        must keep that above page_burn or it can NEVER page (the 0.9
+        objective capped burn at 10 < 14.4, silently)."""
+        spec = next(
+            s for s in stream_slos(capacity=4)
+            if s.name == "stream_slot_occupancy"
+        )
+        assert 1.0 / spec.budget >= spec.page_burn
+        t, clk = _clocked()
+        tel = Telemetry(clock=clk)
+        eng = SloEngine(
+            [spec.scaled(0.001)], tel, clock=clk
+        )  # fast 0.3s / slow 3.6s windows
+        tel.gauge_set("stream_slot_occupancy", 4)  # pinned full table
+        for i in range(80):
+            t["now"] = i * 0.05
+            eng.evaluate()
+        assert eng.verdicts()["stream_slot_occupancy"].page
+
+    def test_page_during_warming_degrades_once_ready(self):
+        """Review regression: a page edge while the tracker is still
+        STARTING/WARMING is an illegal degrade edge (no-op); the ONGOING
+        page must still flip health the next evaluation after the
+        subsystem becomes READY — edges alone would leave it 'ready'
+        for the whole page."""
+        t, clk = _clocked()
+        tel = Telemetry(clock=clk)
+        spec = SloSpec("shed", "serve", "ratio", objective=0.9,
+                       bad="bad_total", total="all_total",
+                       fast_window_s=30, slow_window_s=300,
+                       page_burn=2.0, min_events=1)
+        eng = SloEngine([spec], tel, clock=clk)
+        tracker = tel.health("serve")
+        tracker.warming()  # page will fire during warmup
+        eng.evaluate()
+        tel.inc("all_total", 10)
+        tel.inc("bad_total", 10)
+        t["now"] = 1.0
+        eng.evaluate()
+        assert eng.paging("serve")
+        assert tracker.state == WARMING  # degrade edge was illegal here
+        tracker.ready("warmup done")
+        t["now"] = 2.0
+        eng.evaluate()  # page still ongoing: degrade re-asserted
+        assert tracker.state == DEGRADED
+        # And a fresh tracker (re-entrant driver) degrades too.
+        fresh = tel.health("serve", fresh=True)
+        fresh.ready("second server")
+        t["now"] = 3.0
+        eng.evaluate()
+        assert fresh.state == DEGRADED

@@ -273,8 +273,23 @@ def main(argv=None) -> int:
     # boundary (multihost: agreed via a fixed-cadence all-reduce so every
     # process saves the same step).
     preempt = loop_scope.enter_context(PreemptionHandler())
+    # Flight recorder (observability/flight.py): every fault exit of
+    # this run — sentinel halt (76), preemption drain (75) — banks one
+    # bounded atomic dump under the run dir, next to the checkpoints a
+    # postmortem reads anyway. Attached per-run to the process hub
+    # (right before the loop, past every argument-validation exit);
+    # detached in the teardown so re-entrant runs (tests) never dump
+    # into a stale directory.
+    from raft_ncup_tpu.observability import FlightRecorder, get_telemetry
+
+    tel = get_telemetry()
+    prev_flight = tel.flight
+    if is_main_process():
+        tel.flight = FlightRecorder(os.path.join(run_dir, "flight"))
+    train_health = tel.health("train", fresh=True)
     status = 0
     preempted = halted = False
+    train_health.ready(f"training from step {step_i}")
     try:
         while step_i < total:
             if preempt.poll(step_i):
@@ -336,6 +351,18 @@ def main(argv=None) -> int:
                         "train_sentinel_halt", step=step_i,
                         consecutive=int(sen["consecutive"]),
                     )
+                    train_health.halted(
+                        f"sentinel: {int(sen['consecutive'])} "
+                        f"consecutive bad steps @ {step_i}"
+                    )
+                    # Fault trigger: bank the timeline (sentinel gauges,
+                    # io-retry events, the halt event itself) before the
+                    # rollback + exit-76 path discards the process.
+                    tel.flight_dump(
+                        "sentinel_halt", step=step_i,
+                        consecutive=int(sen["consecutive"]),
+                        skipped=int(sen["skipped"]),
+                    )
                     halted = True
                     break
             if step_i % train_cfg.val_freq == 0 or step_i == total:
@@ -351,6 +378,14 @@ def main(argv=None) -> int:
             # preemption into a crash exit.
             if ckpt.latest_step != step_i:
                 ckpt.save(state)  # synchronous: committed on return
+            train_health.draining(f"preempted @ {step_i}")
+            # Fault trigger: the drain decision + the timeline that led
+            # to it (preemption_signal event included), banked AFTER the
+            # checkpoint commit so the dump can name a saved step.
+            tel.flight_dump(
+                "preemption_drain", step=step_i,
+                checkpoint_step=ckpt.latest_step,
+            )
             logger.write_text(
                 f"preempted @ {step_i}: checkpoint saved, exiting "
                 f"{EXIT_PREEMPTED}"
@@ -409,6 +444,9 @@ def main(argv=None) -> int:
             except Exception as e:
                 print(f"teardown ({closer.__qualname__}): {e}",
                       file=sys.stderr)
+        # Detach this run's flight recorder (re-entrant runs must not
+        # dump into a finished run's directory).
+        get_telemetry().flight = prev_flight
     if status == 0:
         print(f"done: {int(state.step)} steps, checkpoints in {run_dir}")
     else:
