@@ -392,6 +392,23 @@ def _child_main() -> None:
         except Exception as e:  # never lose the earlier rows
             print(f"stream bench failed: {e}", file=sys.stderr)
 
+    # Fleet row (docs/FLEET.md; docs/PERF.md "Fleet"): N real serve.py
+    # replica processes behind the host-only FleetRouter, the same
+    # open-loop steady-state window as the serve row — fleet_p50/p99 vs
+    # serve_p50/p99 is the measured router-hop cost, per-replica guard
+    # counters must all be 0, and every replica drains on the exit-75
+    # contract at teardown. Spawns processes (each pays its own model
+    # warmup), so it rides a generous budget gate;
+    # BENCH_SKIP_FLEET=1 turns it off explicitly.
+    if os.environ.get("BENCH_SKIP_FLEET") == "1":
+        pass
+    elif child_budget - (time.monotonic() - t0) > 0.3 * child_budget:
+        try:
+            record.update(_measure_fleet(shape, corr_impl))
+            _emit(record)
+        except Exception as e:  # never lose the earlier rows
+            print(f"fleet bench failed: {e}", file=sys.stderr)
+
     # bf16 rows (docs/PRECISION.md; ROADMAP item 3): the same guarded
     # forward / train-loop / val / serve / stream measurements re-run
     # under the precision policy's bf16 presets, every key suffixed
@@ -1328,6 +1345,160 @@ def _measure_stream(
         "stream_health": health_state,
         "stream_slo_pages": slo_snap["pages_total"],
         "stream_slo": slo_snap["verdicts"],
+    }
+
+
+def _measure_fleet(shape: dict, corr_impl: str) -> dict:
+    """Guarded fleet-tier row (fleet/; docs/FLEET.md): N real serve.py
+    replica child processes behind the FleetRouter, measured over the
+    same open-loop steady-state discipline as the serve row so
+    ``fleet_p50_ms``/``fleet_p99_ms`` read directly against
+    ``serve_p50_ms``/``serve_p99_ms`` — the delta is the router hop
+    (wire marshalling + socket + supervision), the thing a fleet
+    deployment pays per request.
+
+    Honesty gates mirror the serve row at fleet granularity:
+    ``fleet_replica_recompiles``/``fleet_replica_host_transfers`` carry
+    EVERY replica's guard counters over its service window (serve.py
+    replica mode arms RecompileWatchdog + forbid_host_transfers after
+    warmup) and must all be 0; ``fleet_shed``/``fleet_errors``/
+    ``fleet_failovers`` must be 0 (a window that shed or failed over
+    measured robustness, not service); drain-contract violations from
+    the supervisor disqualify the row. Per-replica occupancy
+    (``fleet_per_replica_completed``) makes routing skew visible.
+
+    The row spawns real processes: BENCH_FLEET_REPLICAS (default 2)
+    bounds the fleet, BENCH_FLEET_REQUESTS (default 12) the window, and
+    BENCH_SKIP_FLEET=1 turns the row off.
+    """
+    import numpy as np
+
+    from raft_ncup_tpu.config import ServeConfig
+    from raft_ncup_tpu.data.synthetic import SyntheticFlowDataset
+    from raft_ncup_tpu.fleet import (
+        FleetConfig,
+        FleetRouter,
+        ReplicaSupervisor,
+    )
+    from raft_ncup_tpu.observability import Telemetry
+    from raft_ncup_tpu.serving import nearest_rank_ms
+
+    H, W = shape["height"], shape["width"]
+    iters = shape["iters"]
+    n_replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", "2"))
+    n = int(os.environ.get("BENCH_FLEET_REQUESTS", "12"))
+    platform = os.environ.get("_BENCH_FORCE_PLATFORM") or "cpu"
+
+    import tempfile
+
+    base = tempfile.mkdtemp(prefix="bench_fleet_")
+    cfg = FleetConfig(
+        base_dir=base,
+        n_replicas=n_replicas,
+        size_hw=(H, W),
+        # One iteration level and a small batch set: the row measures
+        # the router hop, not the executable-set arithmetic the serve
+        # row already covers — and every replica pays its own warmup.
+        serve=ServeConfig(
+            queue_capacity=max(8, n), batch_sizes=(1, 2),
+            iter_levels=(iters,), recover_patience=2,
+        ),
+        stream=None,  # request-only row; stream blast radius is test-pinned
+        extra_args=(
+            "--model", "raft_nc_dbl", "--corr_impl", corr_impl,
+            "--platform", platform,
+        ),
+        snapshot_interval_s=0.5,
+    )
+    tel = Telemetry()
+    sup = ReplicaSupervisor(cfg, telemetry=tel)
+    ds = SyntheticFlowDataset((H, W), length=max(4, n), seed=95,
+                              style="rigid")
+    try:
+        sup.start()  # blocks until every replica's healthz reads ready
+        router = FleetRouter(cfg, sup, telemetry=tel)
+
+        def frame(i):
+            s = ds.sample(i % len(ds))
+            return (np.asarray(s["image1"], np.float32),
+                    np.asarray(s["image2"], np.float32))
+
+        # Calibrate the open-loop rate through the full router hop.
+        t0 = time.perf_counter()
+        for i in range(2):
+            img1, img2 = frame(i)
+            router.submit(img1, img2).result(timeout=120.0)
+        per_pair = (time.perf_counter() - t0) / 2.0
+        interval = per_pair * 1.3
+
+        handles = []
+        t0 = time.perf_counter()
+        for i in range(n):
+            img1, img2 = frame(i)
+            handles.append(router.submit(img1, img2))
+            time.sleep(interval)
+        responses = [h.result(timeout=120.0) for h in handles]
+        dt = time.perf_counter() - t0
+        rreport = router.report()
+        router.drain()
+    finally:
+        reports = sup.stop()
+
+    lat = [
+        r.latency_s for r in responses if r.ok and r.latency_s is not None
+    ]
+    if not lat:
+        raise RuntimeError(
+            f"no ok responses in fleet window: {rreport['stats']}"
+        )
+    per_replica = {
+        i: (reports.get(i) or {}).get("report") or {}
+        for i in range(n_replicas)
+    }
+    sup_report = sup.report()
+    return {
+        "fleet_pairs_per_sec": round(len(lat) / dt, 4) if dt > 0 else 0.0,
+        "fleet_p50_ms": nearest_rank_ms(lat, 0.50),
+        "fleet_p99_ms": nearest_rank_ms(lat, 0.99),
+        "fleet_requests": n,
+        "fleet_ok": len(lat),
+        "fleet_replicas": n_replicas,
+        "fleet_interval_ms": round(interval * 1e3, 1),
+        "fleet_iters": iters,
+        "fleet_shed": rreport["stats"]["shed"],
+        "fleet_errors": sum(
+            1 for r in responses if r.status == "error"
+        ),
+        # Replica-side timeouts/rejections shrink the latency sample
+        # silently unless recorded — the serve row's honesty rule at
+        # fleet granularity (flip gates on them).
+        "fleet_timeouts": sum(
+            1 for r in responses if r.status == "timeout"
+        ),
+        "fleet_rejected": sum(
+            1 for r in responses if r.status == "rejected"
+        ),
+        "fleet_failovers": rreport["stats"]["failovers"],
+        "fleet_deaths": sup_report["deaths"],
+        "fleet_restarts": sup_report["restarts"],
+        "fleet_contract_violations": sup_report["contract_violations"],
+        # Per-replica guard counters over each replica's whole service
+        # window (serve.py replica mode): all must be 0.
+        "fleet_replica_recompiles": [
+            per_replica[i].get("recompiles") for i in range(n_replicas)
+        ],
+        "fleet_replica_host_transfers": [
+            per_replica[i].get("host_transfers")
+            for i in range(n_replicas)
+        ],
+        # Occupancy: who actually carried the window (routing skew).
+        "fleet_per_replica_completed": [
+            per_replica[i].get("completed") for i in range(n_replicas)
+        ],
+        "fleet_per_replica_dispatched": [
+            rreport["per_replica_dispatched"].get(i, 0)
+            for i in range(n_replicas)
+        ],
     }
 
 

@@ -11,11 +11,15 @@ short epoch). The retry/quarantine layer (resilience/retry.py) exists
 precisely so absorbing an error is always *accounted* — this rule keeps
 everyone on that path.
 
-Scoped to ``resilience/``, ``training/`` and ``data/``. Narrow handler
-types (``except queue.Empty: pass``, ``except ImportError: pass``) are
-out of scope: catching a *specific* expected exception and dropping it
-is a decision, not an accident. Audited exceptions go through the
-allowlist with a justification, like every other rule.
+Scoped to ``resilience/``, ``training/``, ``data/`` and ``fleet/`` —
+the fleet supervisor most of all: a supervisor that silently eats a
+child replica's death is the exact failure mode the fleet tier exists
+to prevent (an unnoticed dead replica = silent capacity loss + hung
+clients; docs/FLEET.md). Narrow handler types (``except queue.Empty:
+pass``, ``except ImportError: pass``) are out of scope: catching a
+*specific* expected exception and dropping it is a decision, not an
+accident. Audited exceptions go through the allowlist with a
+justification, like every other rule.
 """
 
 from __future__ import annotations
@@ -33,11 +37,11 @@ from raft_ncup_tpu.analysis.astutil import (
 RULE_ID = "JGL007"
 SUMMARY = (
     "swallowed exception (broad except, no re-raise/handling) in "
-    "resilience/, training/, data/"
+    "resilience/, training/, data/, fleet/"
 )
 
 _BROAD = frozenset({"Exception", "BaseException"})
-_SCOPE_DIRS = ("resilience", "training", "data")
+_SCOPE_DIRS = ("resilience", "training", "data", "fleet")
 
 
 def _in_scope(path: str) -> bool:

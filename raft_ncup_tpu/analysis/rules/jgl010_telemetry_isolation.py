@@ -9,8 +9,14 @@ device round-trip on the hot path *from the observer*, and an observer
 that perturbs the observed steady state is worse than none (the bench's
 telemetry-on-vs-off overhead row measures exactly this).
 
-So ``raft_ncup_tpu/observability/`` is host-only stdlib by construction,
-and this rule enforces it statically:
+So ``raft_ncup_tpu/observability/`` is host-only stdlib by
+construction, and ``raft_ncup_tpu/fleet/`` (host-only stdlib + numpy)
+shares the contract with the constraint sharpened: the fleet router
+sits in front of EVERY request — a router that can touch a device array
+can add a device sync to the whole fleet's traffic, and a replica
+supervisor that imports jax initializes a backend in a process whose
+entire job is to watch other processes own the devices. This rule
+enforces both statically:
 
 - **no jax import at all** (``import jax``, ``from jax import ...``,
   ``import jax.numpy``): the package must stay importable — and
@@ -42,8 +48,9 @@ from raft_ncup_tpu.analysis.astutil import (
 
 RULE_ID = "JGL010"
 SUMMARY = (
-    "jax import or device-array access inside observability/ — telemetry "
-    "is host-only and must never add a sync"
+    "jax import or device-array access inside observability/ or fleet/ "
+    "— telemetry and the fleet control plane are host-only and must "
+    "never add a sync"
 )
 
 _JAX_CALLS = frozenset(
@@ -59,7 +66,10 @@ _METHOD_PULLS = frozenset({"item", "tolist"})
 
 def _in_scope(path: str) -> bool:
     p = path.replace("\\", "/")
-    return "/observability/" in p or p.startswith("observability/")
+    return any(
+        f"/{d}/" in p or p.startswith(f"{d}/")
+        for d in ("observability", "fleet")
+    )
 
 
 def check(ctx: ModuleContext) -> Iterator[Finding]:
@@ -72,7 +82,7 @@ def check(ctx: ModuleContext) -> Iterator[Finding]:
                 if root == "jax":
                     yield Finding(
                         ctx.path, node.lineno, node.col_offset, RULE_ID,
-                        f"`import {alias.name}` in observability/: "
+                        f"`import {alias.name}` in observability//fleet/: "
                         "telemetry is host-only stdlib — a jax import "
                         "here puts device-array access (and backend "
                         "initialization) one attribute away from every "
@@ -85,7 +95,7 @@ def check(ctx: ModuleContext) -> Iterator[Finding]:
             if root == "jax":
                 yield Finding(
                     ctx.path, node.lineno, node.col_offset, RULE_ID,
-                    f"`from {node.module} import ...` in observability/: "
+                    f"`from {node.module} import ...` in observability//fleet/: "
                     "telemetry is host-only stdlib (see JGL010)",
                     qualname(node),
                 )
@@ -96,7 +106,7 @@ def check(ctx: ModuleContext) -> Iterator[Finding]:
             ):
                 yield Finding(
                     ctx.path, node.lineno, node.col_offset, RULE_ID,
-                    f"`{dn}` call in observability/: a device access "
+                    f"`{dn}` call in observability//fleet/: a device access "
                     "inside telemetry adds the very sync the guarded "
                     "hot path forbids — pull at the producer's "
                     "sanctioned boundary and hand telemetry the host "
@@ -106,7 +116,7 @@ def check(ctx: ModuleContext) -> Iterator[Finding]:
             elif dn in _NUMPY_PULLS:
                 yield Finding(
                     ctx.path, node.lineno, node.col_offset, RULE_ID,
-                    f"`{dn}` call in observability/: on a jax array this "
+                    f"`{dn}` call in observability//fleet/: on a jax array this "
                     "is an implicit device→host pull (the runtime "
                     "guard's exact intercept list) — telemetry receives "
                     "host numbers, it never converts",
@@ -120,7 +130,7 @@ def check(ctx: ModuleContext) -> Iterator[Finding]:
             ):
                 yield Finding(
                     ctx.path, node.lineno, node.col_offset, RULE_ID,
-                    f"`.{node.func.attr}()` call in observability/: on a "
+                    f"`.{node.func.attr}()` call in observability//fleet/: on a "
                     "jax array this is an implicit device→host pull — "
                     "telemetry receives host numbers, it never converts",
                     qualname(node),

@@ -1,0 +1,236 @@
+"""The fleet topology object: one frozen declarative config every other
+piece reads (docs/FLEET.md).
+
+The multi-GPU-abstraction pattern of PAPERS.md arXiv:2606.11390 applied
+to process topology: the replica supervisor spawns FROM it, the router
+routes FROM it, bench and chaos replay AGAINST it, and the tests assert
+ON it — nothing else defines how many replicas exist, where their
+sockets and healthz files live, what executable set each one warms, or
+how much failover/restart budget the fleet has. A fleet whose shape is
+scattered across flag defaults cannot be reasoned about when a replica
+dies; one whose shape is a single validated object can.
+
+Host-only stdlib (+ the repo's own jax-free config dataclasses): the
+router process must be able to hold this object without importing jax
+(JGL010's scope covers ``fleet/``).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+from raft_ncup_tpu.config import ServeConfig, StreamConfig
+
+
+def padded_shape(
+    h: int, w: int, divisor: int = 8, bucket: int = 0
+) -> Tuple[int, int]:
+    """The padded (H, W) a native frame batches under — the pure-host
+    mirror of ``ops/padding.InputPadder``'s pad arithmetic (height pads
+    to a multiple of ``divisor`` = 8*spatial, width to a multiple of 8;
+    a ``bucket`` rounds both up to multiples of itself). The router uses
+    it to match a request's shape key against the replicas'
+    healthz-advertised warmed executable sets without importing jax
+    (tests/test_fleet.py pins it against the real InputPadder)."""
+    h, w = int(h), int(w)
+    if bucket:
+        return h + (-h % bucket), w + (-w % bucket)
+    return h + (-h % divisor), w + (-w % 8)
+
+
+@dataclass(frozen=True)
+class ReplicaSpec:
+    """One replica's addresses, derived from :class:`FleetConfig` —
+    where its Unix socket listens, where it rewrites its healthz file,
+    and where its flight recorder banks fault dumps."""
+
+    index: int
+    socket_path: str
+    healthz_path: str
+    flight_dir: str
+    mesh: Optional[Tuple[int, int]] = None
+
+
+@dataclass(frozen=True)
+class FleetConfig:
+    """The whole fleet as one validated object.
+
+    ``serve`` / ``stream`` are the per-replica subsystem configs (every
+    replica runs a :class:`~raft_ncup_tpu.serving.server.FlowServer`;
+    ``stream=None`` disables the per-replica StreamEngine for
+    request-only fleets). ``meshes`` optionally pins a per-replica
+    (data, spatial) mesh slice — the fleet analogue of the device mesh:
+    which devices each replica owns is topology, not a replica-local
+    flag.
+    """
+
+    # Directory holding every replica's socket, healthz file, and
+    # flight dir (one tree per fleet run: the postmortem surface).
+    base_dir: str
+    n_replicas: int = 2
+    # Native frame size the replicas warm at (the serve.py --size).
+    size_hw: Tuple[int, int] = (96, 128)
+    serve: ServeConfig = field(default_factory=ServeConfig)
+    stream: Optional[StreamConfig] = None
+    # Per-replica (data, spatial) mesh slices; None = unsharded
+    # everywhere. Length must equal n_replicas when given.
+    meshes: Optional[tuple] = None
+    # Extra serve.py argv forwarded verbatim (model/platform flags).
+    extra_args: Tuple[str, ...] = ()
+
+    # --- healthz cadence + the staleness contract -----------------------
+    # Replicas rewrite healthz on this cadence; a consumer MUST treat a
+    # file whose time_unix_s is older than ``stale_after_s`` as a dead
+    # replica even if the process still exists (a SIGSTOPped or wedged
+    # replica lingers but cannot serve). Default: 2x the cadence — the
+    # schema contract pinned in tests/test_observability.py.
+    snapshot_interval_s: float = 0.25
+    stale_after_factor: float = 2.0
+    # Supervisor poll cadence + lifecycle timeouts.
+    poll_interval_s: float = 0.1
+    spawn_timeout_s: float = 120.0
+    drain_timeout_s: float = 90.0
+
+    # --- router admission + failover budgets ----------------------------
+    # Outstanding (dispatched, unanswered) requests the router allows
+    # per replica before it sheds AT THE ROUTER — backpressure must bite
+    # before work crosses a process boundary.
+    max_inflight_per_replica: int = 16
+    # Shed hint when no replica has advertised anything better.
+    default_retry_after_s: float = 0.25
+    # How many times one request may be re-dispatched after a replica
+    # death before it terminates honestly (shed/error, never silence).
+    max_failovers: int = 1
+
+    # --- supervisor restart budgets + circuit breaker -------------------
+    max_restarts: int = 2  # per replica, counted
+    restart_backoff_s: float = 0.25  # doubles per consecutive failure
+    restart_backoff_max_s: float = 5.0
+    # K consecutive failures (death/staleness without an intervening
+    # healthy serve) opens the replica's circuit breaker: no restart,
+    # no traffic — a crash-looping replica must stop eating requests.
+    circuit_break_after: int = 3
+
+    def __post_init__(self) -> None:
+        if self.n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1: {self.n_replicas}")
+        if not self.base_dir:
+            raise ValueError("base_dir is required (sockets/healthz live there)")
+        h, w = self.size_hw
+        if int(h) < 16 or int(w) < 16:
+            raise ValueError(f"size_hw too small for the pyramid: {self.size_hw}")
+        if self.meshes is not None:
+            if len(self.meshes) != self.n_replicas:
+                raise ValueError(
+                    f"meshes has {len(self.meshes)} entries for "
+                    f"{self.n_replicas} replicas — the topology object "
+                    "must name every replica's mesh slice explicitly"
+                )
+        for name in (
+            "snapshot_interval_s", "poll_interval_s", "spawn_timeout_s",
+            "drain_timeout_s", "restart_backoff_s", "restart_backoff_max_s",
+            "default_retry_after_s",
+        ):
+            if getattr(self, name) <= 0:
+                raise ValueError(f"{name} must be > 0: {getattr(self, name)}")
+        if self.stale_after_factor < 1.0:
+            raise ValueError(
+                "stale_after_factor < 1 declares a fresh file stale: "
+                f"{self.stale_after_factor}"
+            )
+        if self.max_inflight_per_replica < 1:
+            raise ValueError(
+                f"max_inflight_per_replica must be >= 1: "
+                f"{self.max_inflight_per_replica}"
+            )
+        if self.max_failovers < 0 or self.max_restarts < 0:
+            raise ValueError("failover/restart budgets must be >= 0")
+        if self.circuit_break_after < 1:
+            raise ValueError(
+                f"circuit_break_after must be >= 1: {self.circuit_break_after}"
+            )
+
+    # ------------------------------------------------------------ derived
+
+    @property
+    def stale_after_s(self) -> float:
+        """The staleness bound: healthz older than this ⇒ replica
+        presumed dead even if the process lingers."""
+        return self.snapshot_interval_s * self.stale_after_factor
+
+    def replica(self, i: int) -> ReplicaSpec:
+        if not 0 <= i < self.n_replicas:
+            raise ValueError(f"replica {i} out of range 0..{self.n_replicas - 1}")
+        return ReplicaSpec(
+            index=i,
+            socket_path=os.path.join(self.base_dir, f"replica_{i}.sock"),
+            healthz_path=os.path.join(
+                self.base_dir, f"replica_{i}.healthz.json"
+            ),
+            flight_dir=os.path.join(self.base_dir, f"replica_{i}_flight"),
+            mesh=None if self.meshes is None else self.meshes[i],
+        )
+
+    def replicas(self) -> list:
+        return [self.replica(i) for i in range(self.n_replicas)]
+
+    def pad_divisor(self, i: int) -> int:
+        """Replica ``i``'s pad divisor (8 * spatial under a mesh)."""
+        spec = self.replica(i)
+        return 8 * (spec.mesh[1] if spec.mesh else 1)
+
+    def shape_key(self, h: int, w: int, i: int = 0) -> Tuple[int, int]:
+        """The padded shape a native (h, w) request batches under on
+        replica ``i`` — the key matched against the replica's
+        healthz-advertised warmed executable set."""
+        return padded_shape(
+            h, w, divisor=self.pad_divisor(i), bucket=self.serve.pad_bucket
+        )
+
+    def replica_argv(self, i: int) -> list:
+        """The serve.py argument vector that realizes replica ``i`` of
+        THIS topology — the supervisor spawns exactly this; bench and
+        the tests print it for reproduction. (The interpreter and the
+        serve.py path are the caller's: they depend on the environment,
+        not the topology.)"""
+        spec = self.replica(i)
+        s, st = self.serve, self.stream
+        argv = [
+            "--replica_socket", spec.socket_path,
+            "--replica_index", str(i),
+            "--healthz_file", spec.healthz_path,
+            "--flight_dir", spec.flight_dir,
+            "--telemetry_interval_s", str(self.snapshot_interval_s),
+            "--size", str(self.size_hw[0]), str(self.size_hw[1]),
+            "--queue_capacity", str(s.queue_capacity),
+            "--serve_batch_sizes", ",".join(str(b) for b in s.batch_sizes),
+            "--iter_levels", ",".join(str(x) for x in s.iter_levels),
+            "--high_water", str(s.high_water),
+            "--low_water", str(s.low_water),
+            "--recover_patience", str(s.recover_patience),
+            "--serve_pad_bucket", str(s.pad_bucket),
+            "--serve_cache_size", str(s.cache_size),
+        ]
+        if s.precision is not None:
+            argv += ["--serve_precision", s.precision]
+        if st is None:
+            argv += ["--replica_streams", "false"]
+        else:
+            argv += [
+                "--replica_streams", "true",
+                "--stream_capacity", str(st.capacity),
+                "--stream_iters", str(st.iters),
+                "--stream_batch_sizes", ",".join(
+                    str(b) for b in st.batch_sizes
+                ),
+                "--stream_queue_capacity", str(st.queue_capacity),
+                "--max_frame_gap", str(st.max_frame_gap),
+                "--idle_timeout_s", str(st.idle_timeout_s),
+                "--stream_pad_bucket", str(st.pad_bucket),
+            ]
+        if spec.mesh is not None:
+            argv += ["--mesh", f"{spec.mesh[0]},{spec.mesh[1]}"]
+        argv += list(self.extra_args)
+        return argv

@@ -1,0 +1,109 @@
+"""Socket frame protocol between the router and a replica server:
+length-prefixed JSON header + raw C-order ndarray payloads over a Unix
+domain socket (docs/FLEET.md "Wire format").
+
+One frame is::
+
+    u32 big-endian header length
+    header JSON (utf-8): {"kind": ..., ..., "arrays": [
+        {"shape": [...], "dtype": "<numpy dtype str>"}, ...]}
+    for each entry of header["arrays"]: that array's raw C-order bytes
+
+JSON carries the control fields a human can read in a pcap; the pixel
+payloads ride as raw bytes because base64-ing megabytes of frames into
+JSON would triple the router's copy costs. The receiver wraps each
+payload with ``np.frombuffer`` (zero-copy, read-only — every consumer
+downstream stages/copies anyway).
+
+Host-only stdlib + numpy (JGL010 covers ``fleet/``): the wire layer
+must never be able to touch a device array — producers hand it host
+ndarrays that were pulled at their own sanctioned boundaries.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Sanity bound on a single header (a corrupt length prefix must fail
+# loudly, not allocate gigabytes).
+MAX_HEADER_BYTES = 1 << 20
+
+_LEN = struct.Struct(">I")
+
+
+def send_msg(sock: socket.socket, header: dict,
+             arrays: Sequence[np.ndarray] = ()) -> None:
+    """Send one frame. ``header`` must not carry an ``arrays`` key of
+    its own — the descriptor list is derived from ``arrays``."""
+    if "arrays" in header:
+        raise ValueError("header key 'arrays' is reserved for the wire")
+    payloads = []
+    descs = []
+    for arr in arrays:
+        if not isinstance(arr, np.ndarray):
+            raise TypeError(
+                f"wire payloads must be host ndarrays, got "
+                f"{type(arr).__name__} (pull at the producer's "
+                "sanctioned boundary first)"
+            )
+        payloads.append(arr.tobytes())  # C-order copy if non-contiguous
+        descs.append({"shape": list(arr.shape), "dtype": str(arr.dtype)})
+    blob = json.dumps({**header, "arrays": descs}).encode("utf-8")
+    if len(blob) > MAX_HEADER_BYTES:
+        raise ValueError(f"header too large: {len(blob)} bytes")
+    sock.sendall(_LEN.pack(len(blob)) + blob + b"".join(payloads))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly ``n`` bytes; None on clean EOF at a frame boundary
+    (0 bytes read). A mid-frame EOF raises — a half message means the
+    peer died mid-send and the frame must not be trusted."""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            if got == 0:
+                return None
+            raise ConnectionError(
+                f"peer closed mid-frame ({got}/{n} bytes)"
+            )
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def recv_msg(
+    sock: socket.socket,
+) -> Optional[Tuple[dict, List[np.ndarray]]]:
+    """Receive one frame; ``None`` on clean EOF (peer closed between
+    frames). Returns ``(header, arrays)`` with the descriptor list
+    stripped back off the header."""
+    raw_len = _recv_exact(sock, _LEN.size)
+    if raw_len is None:
+        return None
+    (n,) = _LEN.unpack(raw_len)
+    if n > MAX_HEADER_BYTES:
+        raise ValueError(f"frame header length {n} exceeds bound")
+    blob = _recv_exact(sock, n)
+    if blob is None:
+        raise ConnectionError("peer closed between length and header")
+    header = json.loads(blob.decode("utf-8"))
+    descs = header.pop("arrays", [])
+    arrays: List[np.ndarray] = []
+    for d in descs:
+        dtype = np.dtype(d["dtype"])
+        shape = tuple(int(x) for x in d["shape"])
+        count = 1
+        for x in shape:
+            count *= x
+        payload = _recv_exact(sock, count * dtype.itemsize)
+        if payload is None:
+            raise ConnectionError("peer closed before array payload")
+        arrays.append(np.frombuffer(payload, dtype=dtype).reshape(shape))
+    return header, arrays

@@ -40,6 +40,11 @@ from raft_ncup_tpu.observability.telemetry import MetricsRegistry
 
 TELEMETRY_ENV = "RAFT_NCUP_TELEMETRY"
 
+# Process start (unix wall clock), for the healthz replica-identity
+# block: a router distinguishing "same replica, later" from "restarted
+# replica reusing the pid" needs the start time, not just the pid.
+_PROCESS_START_UNIX_S = round(time.time(), 3)
+
 
 class Telemetry:
     """Registry + tracer behind one enable flag, plus the consumer half
@@ -72,6 +77,12 @@ class Telemetry:
         self._health: dict = {}
         self._health_lock = threading.Lock()
         self.slo = None
+        # Replica identity the healthz file advertises to a fleet router
+        # (docs/FLEET.md): producers deposit host facts here — serve.py
+        # threads the warmed (shape, batch, iters) executable set and
+        # the mesh fingerprint through after warmup. Host values only
+        # (JGL010); merged verbatim into every write_healthz payload.
+        self.identity: dict = {}
         self.flight = (
             FlightRecorder(flight_dir) if flight_dir else None
         )
@@ -205,13 +216,30 @@ def telemetry_report(tel: Optional[Telemetry] = None) -> dict:
     return report
 
 
-def write_healthz(path: str, tel: Optional[Telemetry] = None) -> None:
+def write_healthz(
+    path: str,
+    tel: Optional[Telemetry] = None,
+    interval_s: Optional[float] = None,
+) -> None:
     """Atomically rewrite the machine-readable health file a fleet
     router polls (serve.py ``--healthz_file``): per-subsystem health
-    snapshots, the worst-state headline, the SLO verdict block, and the
+    snapshots, the worst-state headline, the SLO verdict block, the
     drain/halt exit contract (DRAINING rides the existing SIGTERM →
-    exit-75 path; HALTED the sentinel → exit-76 one). tmp + ``os.replace``
-    — a poller never reads a torn file."""
+    exit-75 path; HALTED the sentinel → exit-76 one), and the replica
+    identity a router routes on — ``pid``, process start time, plus
+    whatever the producers deposited in ``Telemetry.identity`` (serve.py
+    threads the mesh fingerprint and the warmed ``(shape, batch,
+    iters)`` executable set through after warmup; docs/FLEET.md).
+
+    **Staleness contract**: ``interval_s`` is the rewrite cadence the
+    writer promises; consumers MUST treat a payload whose
+    ``time_unix_s`` is older than ``stale_after_s`` (2x the cadence) as
+    a dead replica even if the process lingers — a wedged or SIGSTOPped
+    replica keeps its pid but stops heartbeating
+    (``fleet/replica.healthz_fresh`` is the reference consumer; schema
+    pinned in tests/test_observability.py).
+
+    tmp + ``os.replace`` — a poller never reads a torn file."""
     tel = tel or get_telemetry()
     health = tel.health_snapshot()
     payload = {
@@ -223,7 +251,18 @@ def write_healthz(path: str, tel: Optional[Telemetry] = None) -> None:
             s["state"] == "draining" for s in health.values()
         ),
         "exit_contract": {"draining": 75, "halted": 76},
+        "pid": os.getpid(),
+        "start_time_unix_s": _PROCESS_START_UNIX_S,
+        **dict(tel.identity),
     }
+    if interval_s is not None:
+        payload["interval_s"] = round(float(interval_s), 3)
+        payload["stale_after_s"] = round(2.0 * float(interval_s), 3)
+    parent = os.path.dirname(path)
+    if parent:
+        # Same courtesy as the flight recorder: a healthz path in a
+        # not-yet-created run dir must not crash the server at startup.
+        os.makedirs(parent, exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as fh:
         json.dump(payload, fh)
@@ -340,7 +379,8 @@ class PeriodicSnapshot:
             })
             self._sink.flush()
         if self._healthz:
-            write_healthz(self._healthz, self._tel)
+            write_healthz(self._healthz, self._tel,
+                          interval_s=self._interval)
 
     def _run(self) -> None:
         while not self._stop.wait(self._interval):

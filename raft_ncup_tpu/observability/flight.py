@@ -141,6 +141,15 @@ class FlightRecorder:
         except OSError as e:
             with self._lock:
                 self.failed += 1
+                # Re-open the rate-limit window: a transient write
+                # failure must not suppress the NEXT fault's dump for
+                # min_interval_s — writing dumps is the recorder's one
+                # job, the limiter only throttles successes.
+                if self._last_by_trigger.get(trigger) == now:
+                    if last is None:
+                        del self._last_by_trigger[trigger]
+                    else:
+                        self._last_by_trigger[trigger] = last
             if tel is not None:
                 # The point event auto-feeds flight_dump_failed_total.
                 tel.event("flight_dump_failed", trigger=trigger,

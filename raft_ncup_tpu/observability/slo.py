@@ -240,6 +240,10 @@ class SloVerdict:
         }
 
 
+# Sample-ring size at which resolution halves (see SloEngine.__init__).
+_RING_CAP = 4096
+
+
 class SloEngine:
     """Evaluate a fixed spec set against a hub's registry on a cadence.
 
@@ -267,9 +271,14 @@ class SloEngine:
         self._clock = clock
         # Per-spec ring of (t, bad_cumulative, total_cumulative) — for
         # gauges, (t, bad01, 1). Pruned to the slow window each
-        # evaluate(); bounded by cadence * slow_window anyway.
+        # evaluate(); beyond _RING_CAP samples the ring HALVES its
+        # resolution instead of evicting its oldest entry — a blind cap
+        # at a sub-second cadence (fleet replicas tick at 0.25 s) would
+        # silently shrink the declared 1 h slow window to
+        # cap x cadence seconds, and burn_slow would page on a horizon
+        # the declared window damps.
         self._samples: Dict[str, deque] = {
-            s.name: deque(maxlen=4096) for s in self.specs
+            s.name: deque() for s in self.specs
         }
         self._paging: Dict[str, bool] = {s.name: False for s in self.specs}
         self._verdicts: Dict[str, SloVerdict] = {}
@@ -350,6 +359,17 @@ class SloEngine:
                 # oldest in-window sample is the delta base).
                 while ring and ring[0][0] < now - spec.slow_window_s:
                     ring.popleft()
+                if len(ring) > _RING_CAP:
+                    # Memory bound WITHOUT shrinking the window: drop
+                    # every other sample, keeping the oldest (the slow
+                    # delta base) and the newest. Counter SLIs are
+                    # cumulative so deltas are exact at any resolution;
+                    # gauge SLIs keep a representative 0/1 sample mix.
+                    kept = list(ring)[::2]
+                    if kept[-1] != ring[-1]:
+                        kept.append(ring[-1])
+                    ring.clear()
+                    ring.extend(kept)
                 samples = list(ring)
                 is_gauge = spec.sli == "gauge"
                 burn_f, frac_f, events_f = self._window_burn(

@@ -52,6 +52,26 @@ deterministic multi-stream schedule):
   admission must shed the overflow (slots are a hard capacity), not
   queue it.
 
+Fleet events (consumed by ``fleet/router.replay_fleet`` + the fleet
+tests/bench; the coordinate is a *fleet-wide submission index* in the
+deterministic schedule, and the TARGET is the replica that carried that
+submission — deterministic because routing is):
+
+- ``killreplica@N`` — right after submission ``N`` dispatches, its
+  replica is SIGKILLed (no drain, no flush) → the dead replica's
+  streams must re-admit elsewhere cold, batch-mates on surviving
+  replicas must be bitwise unaffected, and every stranded request must
+  fail over (deadline permitting) or terminate honestly.
+- ``stallreplica@N`` — submission ``N``'s replica is SIGSTOPped: the
+  process lingers but stops heartbeating → detection must ride the
+  healthz staleness contract (file older than ``stale_after_s`` ⇒
+  presumed dead), the supervisor SIGKILLs the zombie, and failover
+  proceeds as for a death.
+- ``drainreplica@N`` — submission ``N``'s replica is SIGTERMed → the
+  drain contract: healthz shows DRAINING before the flush, zero
+  in-flight losses, child exits 75, and the router routes nothing new
+  there from the moment it observes DRAINING.
+
 NaN injection wraps the *host batch stream* (order-preserving, so batch
 ``i`` of the stream is exactly the batch step ``start_step + i``
 consumes, prefetch depth notwithstanding); the SIGTERM trigger lives in
@@ -70,7 +90,7 @@ import numpy as np
 ENV_VAR = "RAFT_NCUP_CHAOS"
 
 _KINDS = ("nan", "ioerror", "sigterm", "burst", "poison", "corruptframe",
-          "abandon")
+          "abandon", "killreplica", "stallreplica", "drainreplica")
 
 
 @dataclass(frozen=True)
@@ -84,6 +104,9 @@ class ChaosSpec:
     poison_requests: frozenset = frozenset()
     corrupt_frames: frozenset = frozenset()
     abandon_frames: frozenset = frozenset()
+    kill_replica_at: frozenset = frozenset()
+    stall_replica_at: frozenset = frozenset()
+    drain_replica_at: frozenset = frozenset()
 
     @classmethod
     def parse(cls, spec: Optional[str]) -> "ChaosSpec":
@@ -112,6 +135,9 @@ class ChaosSpec:
             frozenset(sets["poison"]),
             frozenset(sets["corruptframe"]),
             frozenset(sets["abandon"]),
+            frozenset(sets["killreplica"]),
+            frozenset(sets["stallreplica"]),
+            frozenset(sets["drainreplica"]),
         )
 
     @property
@@ -119,6 +145,8 @@ class ChaosSpec:
         return bool(self.nan_steps or self.ioerror_reads
                     or self.burst_requests or self.poison_requests
                     or self.corrupt_frames or self.abandon_frames
+                    or self.kill_replica_at or self.stall_replica_at
+                    or self.drain_replica_at
                     or self.sigterm_after is not None)
 
     def render(self) -> str:
@@ -128,6 +156,9 @@ class ChaosSpec:
         parts += [f"poison@{n}" for n in sorted(self.poison_requests)]
         parts += [f"corruptframe@{n}" for n in sorted(self.corrupt_frames)]
         parts += [f"abandon@{n}" for n in sorted(self.abandon_frames)]
+        parts += [f"killreplica@{n}" for n in sorted(self.kill_replica_at)]
+        parts += [f"stallreplica@{n}" for n in sorted(self.stall_replica_at)]
+        parts += [f"drainreplica@{n}" for n in sorted(self.drain_replica_at)]
         if self.sigterm_after is not None:
             parts.append(f"sigterm@{self.sigterm_after}")
         return ",".join(parts) or "<none>"

@@ -52,11 +52,31 @@ from raft_ncup_tpu.serving.request import (  # noqa: F401
     ServeStats,
     nearest_rank_ms,
 )
-from raft_ncup_tpu.serving.server import FlowServer  # noqa: F401
-from raft_ncup_tpu.serving.traffic import (  # noqa: F401
-    SyntheticTraffic,
-    replay,
-)
+
+# FlowServer/traffic import the inference stack (and through it jax);
+# they resolve lazily (PEP 562) so the host-only consumers of the
+# request protocol — the fleet router above all (JGL010: fleet/ must
+# never import jax, even transitively through this package) — can
+# import `raft_ncup_tpu.serving.request` without initializing a backend.
+_LAZY = {
+    "FlowServer": ("raft_ncup_tpu.serving.server", "FlowServer"),
+    "SyntheticTraffic": ("raft_ncup_tpu.serving.traffic", "SyntheticTraffic"),
+    "replay": ("raft_ncup_tpu.serving.traffic", "replay"),
+}
+
+
+def __getattr__(name: str):
+    try:
+        module, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    value = getattr(importlib.import_module(module), attr)
+    globals()[name] = value  # cache: one lazy resolve per process
+    return value
 
 __all__ = [
     "AdmissionQueue",

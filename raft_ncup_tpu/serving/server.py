@@ -159,6 +159,11 @@ class FlowServer:
         self._ema_lock = threading.Lock()
         self._next_id = 0
         self._id_lock = threading.Lock()
+        # The warmed (padded_h, padded_w, batch, iters) executable set,
+        # recorded by warmup(): the replica identity a fleet router
+        # routes shape-aware against (serve.py threads it into the
+        # healthz file via Telemetry.identity; docs/FLEET.md).
+        self.warmed: list = []
         self._draining = threading.Event()
         self._drained = False
         self._thread = threading.Thread(
@@ -175,18 +180,27 @@ class FlowServer:
         image2,
         *,
         deadline_s: Optional[float] = None,
+        request_id: Optional[int] = None,
     ) -> ServeHandle:
         """Submit one frame pair; returns immediately with a handle.
 
         The handle completes with exactly one terminal status (see
         ``serving/request.py``). ``deadline_s`` is seconds from now
         (default ``cfg.default_deadline_s``; ``None`` = no deadline).
+        ``request_id`` lets a fleet router supply ITS correlation id as
+        the request's identity — the replica-side spans then carry the
+        router-side id verbatim, so one ``request_id`` reassembles the
+        journey across the process boundary (docs/FLEET.md;
+        scripts/postmortem.py). Caller owns uniqueness.
         """
         self.stats.note_submitted()
         handle = ServeHandle()
-        with self._id_lock:
-            rid = self._next_id
-            self._next_id += 1
+        if request_id is not None:
+            rid = int(request_id)
+        else:
+            with self._id_lock:
+                rid = self._next_id
+                self._next_id += 1
         if self._draining.is_set():
             self.stats.note_shed()
             handle.complete(FlowResponse(
@@ -493,11 +507,14 @@ class FlowServer:
         (t, b), (le, r) = padder.pad_spec
         ph, pw = int(h) + t + b, int(w) + le + r
         before = self._fwd.stats["compiles"]
+        warmed = []
         for n in self.cfg.batch_sizes:
             zeros = np.zeros((n, ph, pw, 3), np.float32)
             for iters in self.cfg.iter_levels:
                 out = self._fwd.forward_device(zeros, zeros, iters)
                 jax.block_until_ready(out)
+                warmed.append((ph, pw, n, iters))
+        self.warmed = warmed
         compiled = self._fwd.stats["compiles"] - before
         self.health.ready(f"warmup compiled {compiled} programs")
         return compiled

@@ -240,6 +240,7 @@ class StreamEngine:
         self._ema_lock = threading.Lock()
         self._next_id = 0
         self._id_lock = threading.Lock()
+        self.warmed: list = []  # (ph, pw, batch, iters) set, see warmup()
         self._occupancy_sum = 0  # sampled at each dispatched batch
         self._draining = threading.Event()
         self._drained = False
@@ -257,6 +258,7 @@ class StreamEngine:
         image2,
         *,
         frame_index: Optional[int] = None,
+        request_id: Optional[int] = None,
     ) -> ServeHandle:
         """Submit the next frame pair of ``stream_id``; returns a handle.
 
@@ -264,13 +266,18 @@ class StreamEngine:
         possibly shedding). ``frame_index`` defaults to
         last-admitted + 1; explicit indices must be strictly increasing
         per stream, and a gap beyond ``max_frame_gap`` forces a cold
-        start (stale warm state is never used).
+        start (stale warm state is never used). ``request_id`` lets a
+        fleet router supply its correlation id as the frame's identity
+        (docs/FLEET.md; caller owns uniqueness).
         """
         self.stats.note("submitted")
         handle = ServeHandle()
-        with self._id_lock:
-            rid = self._next_id
-            self._next_id += 1
+        if request_id is not None:
+            rid = int(request_id)
+        else:
+            with self._id_lock:
+                rid = self._next_id
+                self._next_id += 1
         if self._draining.is_set():
             self.stats.note("shed_frames")
             handle.complete(FlowResponse(
@@ -813,11 +820,13 @@ class StreamEngine:
         self.health.warming()
         before = self._fwd.stats["compiles"]
         self._queue.set_paused(True)
+        warmed = []
         try:
             import jax.numpy as jnp
 
             scratch = self.cfg.capacity
             for n in self.cfg.batch_sizes:
+                warmed.append((self._ph, self._pw, n, self.cfg.iters))
                 zeros = np.zeros(
                     (n, self._ph, self._pw, 3), np.float32
                 )
@@ -836,6 +845,10 @@ class StreamEngine:
                 jax.block_until_ready((self._table, flow_up, bad))
         finally:
             self._queue.set_paused(False)
+        # The warmed (padded_h, padded_w, batch, iters) step set — the
+        # streaming half of the replica identity serve.py threads into
+        # healthz (docs/FLEET.md).
+        self.warmed = warmed
         compiled = self._fwd.stats["compiles"] - before
         self.health.ready(f"warmup compiled {compiled} programs")
         return compiled

@@ -36,7 +36,9 @@ def recommend(record: dict) -> list[str]:
             "corr_impl='volume', RAFT_NCUP_NCONV_IMPL='xla' pending TPU data"
         ] + _val_row_lines(record) + _serve_row_lines(record) + _bf16_row_lines(
             record
-        ) + _highres_row_lines(record) + _telemetry_lines(record)
+        ) + _highres_row_lines(record) + _fleet_lines(
+            record
+        ) + _telemetry_lines(record)
 
     corr = {"volume": record.get("value")}
     for tag in ("onthefly", "pallas"):
@@ -102,6 +104,7 @@ def recommend(record: dict) -> list[str]:
     lines.extend(_serve_row_lines(record))
     lines.extend(_bf16_row_lines(record))
     lines.extend(_highres_row_lines(record))
+    lines.extend(_fleet_lines(record))
     lines.extend(_telemetry_lines(record))
 
     nc = record.get("pairs_per_sec_nconv_pallas")
@@ -423,6 +426,81 @@ def _slo_lines(record: dict) -> list[str]:
                 f"over {len(verdicts or {})} declared SLO(s))"
             )
     return lines
+
+
+def _fleet_lines(record: dict) -> list[str]:
+    """Fleet row (bench.py ``fleet_*`` fields; docs/FLEET.md) — the
+    serve-row policy applied per replica: absent row → no lines (older
+    records predate the fleet tier); any replica's guard counters
+    nonzero → the whole row is unusable (one leaking replica poisons
+    the fleet percentiles); sheds/errors/failovers or a drain-contract
+    violation → the row measured robustness machinery, not service;
+    clean → the router-hop verdict against the single-replica serve
+    row, with per-replica occupancy."""
+    if record.get("fleet_pairs_per_sec") is None:
+        return []
+    recompiles = record.get("fleet_replica_recompiles") or []
+    transfers = record.get("fleet_replica_host_transfers") or []
+    dirty = [
+        i for i, (r, t) in enumerate(zip(recompiles, transfers))
+        if (r is None or r) or (t is None or t)
+    ]
+    if dirty:
+        return [
+            "fleet: INVARIANT VIOLATED on replica(s) "
+            f"{dirty} (per-replica recompiles {recompiles}, implicit "
+            f"host transfers {transfers}; None = report missing) — the "
+            "fleet_* latencies include a leaking or recompiling "
+            "replica; fix it (docs/FLEET.md) before reading them"
+        ]
+    shed = record.get("fleet_shed") or 0
+    errors = record.get("fleet_errors") or 0
+    failovers = record.get("fleet_failovers") or 0
+    deaths = record.get("fleet_deaths") or 0
+    violations = record.get("fleet_contract_violations") or []
+    # Any response that is not ok shrank the latency sample: timeouts/
+    # rejections count against steady state exactly like sheds, and a
+    # row whose ok count is short of its request count is lossy even if
+    # every per-status field reads 0 (belt and suspenders).
+    timeouts = record.get("fleet_timeouts") or 0
+    rejected = record.get("fleet_rejected") or 0
+    n_req = record.get("fleet_requests")
+    n_ok = record.get("fleet_ok")
+    lossy = (
+        n_req is not None and n_ok is not None and n_ok < n_req
+    )
+    if (shed or errors or failovers or deaths or violations
+            or timeouts or rejected or lossy):
+        return [
+            f"fleet: window NOT steady state ({shed} shed, {errors} "
+            f"error(s), {timeouts} timeout(s), {rejected} rejected, "
+            f"{failovers} failover(s), {deaths} replica "
+            f"death(s), {len(violations)} drain-contract violation(s); "
+            f"ok {n_ok}/{n_req}) "
+            "— the fleet_* numbers measured the robustness machinery, "
+            "not service; rerun bench on a healthy fleet"
+        ]
+    p50 = record.get("fleet_p50_ms")
+    p99 = record.get("fleet_p99_ms")
+    if p50 is None or p99 is None:
+        return [
+            "fleet: row incomplete (no latency percentiles); rerun "
+            "bench for the full fleet row"
+        ]
+    serve_p50 = record.get("serve_p50_ms")
+    hop = (
+        f"; router hop vs single-replica serve row: "
+        f"{p50 - serve_p50:+.1f} ms of p50"
+        if serve_p50 is not None else
+        "; no serve row in this record to compare the router hop against"
+    )
+    occ = record.get("fleet_per_replica_completed")
+    return [
+        f"fleet: steady state {record['fleet_pairs_per_sec']:.2f} "
+        f"pairs/s over {record.get('fleet_replicas', '?')} replicas, "
+        f"p50 {p50:.1f} ms / p99 {p99:.1f} ms "
+        f"(per-replica guard counters all 0; occupancy {occ}){hop}"
+    ]
 
 
 def _serve_row_lines(record: dict) -> list[str]:

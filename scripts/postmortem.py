@@ -25,11 +25,22 @@ Host-only stdlib by construction, like everything it reads: a
 postmortem must be runnable on a laptop from two files, with no jax and
 no backend.
 
+Fleet trees (docs/FLEET.md): a fleet run leaves one flight directory
+per replica (``replica_<i>_flight/``) plus the router's own dumps under
+one base dir. Point the tool at the DIRECTORY and it selects a dump
+deterministically — ``--replica N`` restricts to that replica's
+subtree, and "latest" is decided by the dump filename's embedded
+(timestamp, sequence) pair, not filesystem mtime, so the same tree
+always selects the same dump. The router attaches its correlation id at
+dispatch as the replica-side request id, so one ``--request_id``
+reassembles the journey across the router hop.
+
 Usage:
     python scripts/postmortem.py flight_poison_quarantine_*.json
     python scripts/postmortem.py dump.json --request_id 12
     python scripts/postmortem.py dump.json --stream_id s3 \
         --telemetry_jsonl serve_telemetry.jsonl
+    python scripts/postmortem.py fleet_run_dir/ --replica 1 --request_id 7
 """
 
 from __future__ import annotations
@@ -49,6 +60,54 @@ from raft_ncup_tpu.observability.flight import (  # noqa: E402
 # Context keys that can seed the correlation when no flag is given, in
 # preference order (a request id is the most specific journey).
 _CONTEXT_KEYS = ("request_id", "stream_id", "batch_id")
+
+
+def _dump_sort_key(path: str):
+    """Deterministic recency order for ``flight_<trigger>_<ts>_<seq>``
+    names: the embedded (timestamp, sequence) pair. Filesystem mtime
+    would make 'latest' depend on copy/checkout order; the name never
+    does. Unparsable names sort oldest."""
+    stem = os.path.basename(path)
+    if stem.endswith(".json"):
+        stem = stem[: -len(".json")]
+    parts = stem.split("_")
+    if len(parts) >= 3:
+        ts, seq = parts[-2], parts[-1]
+        if seq.isdigit():
+            return (1, ts, int(seq), stem)
+    return (0, "", 0, stem)
+
+
+def select_dump(tree: str, replica=None) -> str:
+    """Pick ONE dump from a fleet flight tree: restrict to
+    ``replica_<i>_flight/`` when ``--replica`` is given, then take the
+    latest by the filename's (timestamp, seq). Raises with the
+    candidate roster when nothing matches — an empty postmortem must
+    say why."""
+    roots = []
+    if replica is not None:
+        sub = os.path.join(tree, f"replica_{replica}_flight")
+        if not os.path.isdir(sub):
+            raise FileNotFoundError(
+                f"{tree}: no replica_{replica}_flight/ subtree "
+                f"(have: {sorted(os.listdir(tree))})"
+            )
+        roots.append(sub)
+    else:
+        roots.append(tree)
+    candidates = []
+    for root in roots:
+        for dirpath, _, files in os.walk(root):
+            candidates.extend(
+                os.path.join(dirpath, f)
+                for f in files
+                if f.startswith("flight_") and f.endswith(".json")
+            )
+    if not candidates:
+        raise FileNotFoundError(
+            f"no flight_*.json dumps under {roots}"
+        )
+    return max(candidates, key=_dump_sort_key)
 
 
 def _pick_correlation(args, context: dict) -> dict:
@@ -136,19 +195,32 @@ def main(argv=None) -> int:
         description="Reassemble a request/stream journey from a "
         "flight-recorder dump"
     )
-    parser.add_argument("dump", help="flight_<trigger>_<ts>.json path")
+    parser.add_argument("dump", help="flight_<trigger>_<ts>.json path, "
+                        "or a fleet flight directory (latest dump "
+                        "selected deterministically; --replica narrows)")
     parser.add_argument("--request_id", type=int, default=None)
     parser.add_argument("--stream_id", default=None)
     parser.add_argument("--batch_id", type=int, default=None)
+    parser.add_argument("--replica", type=int, default=None,
+                        help="[directory input] select the dump from "
+                        "this replica's replica_<i>_flight/ subtree")
     parser.add_argument("--telemetry_jsonl", default=None,
                         help="serve.py --telemetry_jsonl file: print the "
                         "condensed health/SLO/queue timeline around the "
                         "fault")
     args = parser.parse_args(argv)
 
-    dump = load_dump(args.dump)
+    dump_path = args.dump
+    if os.path.isdir(dump_path):
+        dump_path = select_dump(dump_path, replica=args.replica)
+        print(f"selected dump: {os.path.relpath(dump_path, args.dump)}")
+    elif args.replica is not None:
+        print("--replica only applies to a directory input",
+              file=sys.stderr)
+        return 2
+    dump = load_dump(dump_path)
     context = dump.get("context", {})
-    print(f"flight dump: {os.path.basename(args.dump)}")
+    print(f"flight dump: {os.path.basename(dump_path)}")
     print(f"  trigger:      {dump['trigger']}")
     print(f"  time_unix_s:  {dump.get('time_unix_s')}")
     if context:

@@ -43,6 +43,7 @@ import contextlib
 import json
 import os
 import sys
+import threading
 import time
 
 
@@ -110,6 +111,7 @@ def build_parser() -> argparse.ArgumentParser:
         add_platform_arg,
         add_serve_args,
         add_stream_args,
+        str2bool as _str2bool,
     )
 
     parser = argparse.ArgumentParser(
@@ -141,6 +143,26 @@ def build_parser() -> argparse.ArgumentParser:
                         help="drive the streaming video engine "
                         "(raft_ncup_tpu/streaming/) instead of the "
                         "request server")
+    parser.add_argument("--replica_socket", default=None, metavar="PATH",
+                        help="replica-server mode (raft_ncup_tpu/fleet/; "
+                        "docs/FLEET.md): serve request/frame messages "
+                        "over this Unix domain socket (length-prefixed "
+                        "JSON header + raw ndarray frames) through the "
+                        "FlowServer (+ StreamEngine) instead of "
+                        "replaying synthetic traffic — the child "
+                        "process a fleet ReplicaSupervisor spawns; "
+                        "SIGTERM drains (healthz shows DRAINING before "
+                        "the flush) and exits 75")
+    parser.add_argument("--replica_index", type=int, default=0,
+                        help="[--replica_socket] this replica's index "
+                        "in the fleet topology (report + telemetry "
+                        "correlation)")
+    parser.add_argument("--replica_streams", type=_str2bool,
+                        nargs="?", const=True, default=True,
+                        help="[--replica_socket] also run a "
+                        "StreamEngine so the replica serves stream "
+                        "frames alongside one-shot requests "
+                        "(false = request-only replica)")
     parser.add_argument("--report", action="store_true",
                         help="embed the full telemetry report "
                         "(observability.telemetry_report(): registry "
@@ -211,6 +233,12 @@ def run_stream(args, model, variables) -> int:
     engine = StreamEngine(model, variables, stream_cfg)
     t0 = time.monotonic()
     compiled = engine.warmup()
+    # Replica identity for the healthz file (docs/FLEET.md): the warmed
+    # step set + mesh fingerprint a fleet router routes on.
+    tel.identity.update({
+        "mesh": engine.report()["mesh"],
+        "warmed": [list(x) for x in engine.warmed],
+    })
     print(
         f"warmup: {compiled} stream-step executables compiled in "
         f"{time.monotonic() - t0:.1f}s "
@@ -282,6 +310,266 @@ def run_stream(args, model, variables) -> int:
     return 0
 
 
+def run_replica(args, model, variables) -> int:
+    """--replica_socket mode: one fleet replica (docs/FLEET.md).
+
+    Serves ``request``/``frame`` messages from the router over a Unix
+    domain socket through the existing FlowServer/StreamEngine — the
+    replica IS the single-process serving tier, plus a wire. The
+    service window runs under the runtime guards (0 recompiles after
+    warmup, 0 implicit host transfers — the per-replica counters the
+    fleet bench row asserts), the healthz file advertises the replica
+    identity a router routes on (pid, mesh, warmed executable set), and
+    SIGTERM runs the drain contract: healthz shows DRAINING *before*
+    the flush, everything admitted is flushed, exit 75.
+    """
+    import socket as socket_mod
+    from concurrent.futures import ThreadPoolExecutor
+
+    from raft_ncup_tpu.analysis.guards import (
+        GuardStats,
+        RecompileWatchdog,
+        forbid_host_transfers,
+    )
+    from raft_ncup_tpu.cli import (
+        serve_config_from_args,
+        stream_config_from_args,
+    )
+    from raft_ncup_tpu.fleet.wire import recv_msg, send_msg
+    from raft_ncup_tpu.observability import write_healthz
+    from raft_ncup_tpu.resilience import EXIT_PREEMPTED, PreemptionHandler
+    from raft_ncup_tpu.serving import FlowServer
+
+    size_hw = (args.size[0], args.size[1])
+    serve_cfg = serve_config_from_args(args)
+    tel = _attach_observability(args, stream=False)
+    server = FlowServer(model, variables, serve_cfg)
+    engine = None
+    if args.replica_streams:
+        from raft_ncup_tpu.observability import (
+            SloEngine,
+            serve_slos,
+            stream_slos,
+        )
+        from raft_ncup_tpu.streaming import StreamEngine
+
+        # A replica serving BOTH tiers declares BOTH SLO sets: a
+        # replica that sheds every stream frame while its serve tier is
+        # healthy must page (and read degraded in healthz), or the
+        # router keeps homing streams on it.
+        tel.slo = SloEngine(
+            serve_slos(window_scale=args.slo_window_scale)
+            + stream_slos(args.stream_capacity,
+                          window_scale=args.slo_window_scale),
+            tel,
+        )
+        stream_cfg = stream_config_from_args(args, size_hw)
+        engine = StreamEngine(model, variables, stream_cfg)
+    t0 = time.monotonic()
+    compiled = server.warmup(size_hw)
+    if engine is not None:
+        compiled += engine.warmup()
+    # The replica identity the healthz file advertises (write_healthz
+    # merges Telemetry.identity): the warmed (shape, batch, iters)
+    # executable set is what the router's shape-aware routing reads.
+    tel.identity.update({
+        "replica": args.replica_index,
+        "mesh": server.report()["mesh"],
+        "warmed": [list(x) for x in server.warmed],
+    })
+    if engine is not None:
+        tel.identity["stream_warmed"] = [list(x) for x in engine.warmed]
+    print(
+        f"replica {args.replica_index}: {compiled} executables compiled "
+        f"in {time.monotonic() - t0:.1f}s; serving on "
+        f"{args.replica_socket}",
+        file=sys.stderr,
+    )
+
+    sock_path = args.replica_socket
+    try:
+        os.remove(sock_path)
+    except OSError:
+        pass
+    lsock = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    lsock.bind(sock_path)
+    lsock.listen(16)
+    lsock.settimeout(0.1)
+
+    pool = ThreadPoolExecutor(
+        max_workers=32, thread_name_prefix="replica-respond"
+    )
+    conns: list = []
+
+    def respond(conn, send_lock, rid, handle) -> None:
+        """Wait for one request's terminal response and wire it back
+        (each handle completes exactly once; the drain flush completes
+        every admitted handle, so the bounded wait only trips if the
+        serving tier itself wedged)."""
+        try:
+            r = handle.result(timeout=600.0)
+        except TimeoutError:
+            r = None
+        header = {
+            "kind": "response",
+            "id": rid,
+            "status": "error" if r is None else r.status,
+            "iters": None if r is None else r.iters,
+            "latency_s": None if r is None else r.latency_s,
+            "retry_after_s": None if r is None else r.retry_after_s,
+            "detail": "replica response timeout" if r is None else r.detail,
+        }
+        arrays = (r.flow,) if (r is not None and r.flow is not None) else ()
+        try:
+            with send_lock:
+                send_msg(conn, header, arrays)
+        except OSError:
+            # The router hung up (death detection already failed the
+            # request over on its side); nothing to deliver to.
+            tel.inc("replica_response_undeliverable_total")
+
+    def serve_conn(conn) -> None:
+        send_lock = threading.Lock()
+        try:
+            while True:
+                msg = recv_msg(conn)
+                if msg is None:
+                    break
+                header, arrays = msg
+                kind = header.get("kind")
+                if kind == "ping":
+                    with send_lock:
+                        send_msg(conn, {"kind": "pong", "pid": os.getpid()})
+                    continue
+                rid = int(header.get("id", -1))
+                if kind == "request" and len(arrays) == 2:
+                    handle = server.submit(
+                        arrays[0], arrays[1],
+                        deadline_s=header.get("deadline_s"),
+                        request_id=rid,
+                    )
+                elif kind == "frame" and len(arrays) == 2:
+                    if engine is None:
+                        with send_lock:
+                            send_msg(conn, {
+                                "kind": "response", "id": rid,
+                                "status": "rejected",
+                                "detail": "request-only replica "
+                                "(replica_streams=false)",
+                            })
+                        continue
+                    handle = engine.submit(
+                        str(header.get("stream_id")),
+                        arrays[0], arrays[1],
+                        frame_index=header.get("frame_index"),
+                        request_id=rid,
+                    )
+                else:
+                    with send_lock:
+                        send_msg(conn, {
+                            "kind": "response", "id": rid,
+                            "status": "rejected",
+                            "detail": f"bad message kind {kind!r}",
+                        })
+                    continue
+                pool.submit(respond, conn, send_lock, rid, handle)
+        except (ConnectionError, OSError, ValueError) as e:
+            print(f"replica connection dropped: {e!r}", file=sys.stderr)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    stats = GuardStats()
+    interrupted = False
+    # Guards arm AFTER warmup: every compile from here on is a
+    # steady-state recompile, every implicit pull a leak — the
+    # per-replica counters the fleet bench row requires to be 0.
+    with _telemetry_export(args), PreemptionHandler() as preempt, \
+            RecompileWatchdog() as wd, \
+            forbid_host_transfers(stats, raise_on_violation=False):
+        while not preempt.requested:
+            try:
+                conn, _ = lsock.accept()
+            except socket_mod.timeout:
+                continue
+            except OSError:
+                break
+            conns.append(conn)
+            threading.Thread(
+                target=serve_conn, args=(conn,),
+                name="replica-conn", daemon=True,
+            ).start()
+        interrupted = preempt.requested
+        # Drain contract: DRAINING must be visible to a healthz poller
+        # BEFORE the flush — the router stops routing here while the
+        # in-flight work completes. The explicit write makes the
+        # ordering independent of the snapshot cadence.
+        server.health.draining("sigterm")
+        if engine is not None:
+            engine.health.draining("sigterm")
+        if args.healthz_file:
+            write_healthz(args.healthz_file, tel,
+                          interval_s=args.telemetry_interval_s)
+        sstats = server.drain()
+        estats = engine.drain() if engine is not None else None
+        if interrupted:
+            tel.flight_dump(
+                "preemption_drain",
+                replica=args.replica_index,
+                completed=sstats.completed,
+                shed=sstats.shed,
+            )
+        # Every handle is now terminal; let the responders flush.
+        pool.shutdown(wait=True)
+        # Orderly close of every connection still open: peers get EOF
+        # from the drain, not from process exit.
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+    lsock.close()
+    try:
+        os.remove(sock_path)
+    except OSError:
+        pass
+
+    report = {
+        "replica": args.replica_index,
+        "interrupted": interrupted,
+        "recompiles": wd.count,
+        "host_transfers": stats.host_transfers,
+        "completed": sstats.completed,
+        "shed": sstats.shed,
+        "timeouts": sstats.timeouts,
+        "rejected": sstats.rejected,
+        "errors": sstats.errors,
+        **server.report(),
+        "slo": tel.slo.snapshot() if tel.slo is not None else None,
+    }
+    if estats is not None:
+        report["stream_completed"] = estats.completed
+        report["stream_resets"] = estats.resets
+        report["stream_shed_frames"] = estats.shed_frames
+        report["stream_errors"] = estats.errors
+        report["stream_report"] = engine.report()
+    if args.report:
+        from raft_ncup_tpu.observability import telemetry_report
+
+        report["telemetry"] = telemetry_report()
+    print(json.dumps(report), flush=True)
+    if interrupted:
+        print(
+            f"replica {args.replica_index}: drained after signal — "
+            "everything admitted was flushed; exiting EXIT_PREEMPTED",
+            file=sys.stderr,
+        )
+        return EXIT_PREEMPTED
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     from raft_ncup_tpu.cli import apply_platform
@@ -303,6 +591,8 @@ def main(argv=None) -> int:
     model_cfg = model_config_from_args(args)
     model = RAFT(model_cfg)
     variables = load_variables(model, model_cfg, args.restore_ckpt)
+    if args.replica_socket:
+        return run_replica(args, model, variables)
     if args.stream:
         return run_stream(args, model, variables)
 
@@ -317,6 +607,13 @@ def main(argv=None) -> int:
     server = FlowServer(model, variables, serve_cfg)
     t0 = time.monotonic()
     compiled = server.warmup(size_hw)
+    # Replica identity for the healthz file (docs/FLEET.md): the warmed
+    # (shape, batch, iters) executable set + mesh fingerprint a fleet
+    # router's shape-aware routing reads.
+    tel.identity.update({
+        "mesh": server.report()["mesh"],
+        "warmed": [list(x) for x in server.warmed],
+    })
     print(
         f"warmup: {compiled} executables compiled in "
         f"{time.monotonic() - t0:.1f}s "

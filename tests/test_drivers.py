@@ -471,3 +471,107 @@ class TestServeDriver:
         journey = capsys.readouterr().out
         for stage in ("serve_queue_wait", "serve_dispatch", "serve_drain"):
             assert stage in journey  # the request's full span journey
+
+
+class TestReplicaDriver:
+    def test_replica_mode_serves_wire_and_drains_on_sigterm(
+        self, tmp_path, capsys
+    ):
+        """The fleet replica half of the drain contract through the
+        REAL driver, in-process: serve.py --replica_socket answers a
+        request and a stream frame over the wire protocol, advertises
+        its identity (warmed executable set) through --healthz_file,
+        and on SIGTERM shows DRAINING in healthz, flushes, exits 75
+        with guard counters 0 (docs/FLEET.md)."""
+        import json
+        import signal
+        import socket
+        import threading
+        import time
+
+        import serve as serve_driver
+        from raft_ncup_tpu.fleet.wire import recv_msg, send_msg
+        from raft_ncup_tpu.observability import get_telemetry, set_telemetry
+
+        sock_path = str(tmp_path / "replica.sock")
+        healthz = tmp_path / "healthz.json"
+        client_out = {}
+
+        def client():
+            deadline = time.monotonic() + 120
+            while not os.path.exists(sock_path):
+                if time.monotonic() > deadline:
+                    client_out["error"] = "socket never appeared"
+                    os.kill(os.getpid(), signal.SIGTERM)
+                    return
+                time.sleep(0.05)
+            img = np.random.default_rng(0).uniform(
+                0, 255, (48, 64, 3)
+            ).astype(np.float32)
+            try:
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.connect(sock_path)
+                send_msg(s, {"kind": "request", "id": 5}, [img, img])
+                hdr, arrs = recv_msg(s)
+                client_out["request"] = (hdr, arrs[0].shape if arrs else None)
+                send_msg(s, {"kind": "frame", "id": 6, "stream_id": "sA",
+                             "frame_index": 0}, [img, img])
+                hdr, arrs = recv_msg(s)
+                client_out["frame"] = (hdr, arrs[0].shape if arrs else None)
+                client_out["healthz_live"] = json.load(open(healthz))
+            except Exception as e:  # surfaced via the asserts below
+                client_out["error"] = repr(e)
+            finally:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        prev = set_telemetry(None)
+        t = threading.Thread(target=client, daemon=True)
+        try:
+            t.start()
+            rc = serve_driver.main([
+                "--platform", "cpu", "--small",
+                "--replica_socket", sock_path,
+                "--replica_index", "2",
+                "--size", "48", "64",
+                "--iter_levels", "2",
+                "--serve_batch_sizes", "1,2",
+                "--replica_streams", "true",
+                "--stream_capacity", "2",
+                "--stream_iters", "2",
+                "--stream_batch_sizes", "1,2",
+                "--healthz_file", str(healthz),
+                "--flight_dir", str(tmp_path / "flight"),
+                "--telemetry_interval_s", "0.25",
+            ])
+            t.join(timeout=30)
+        finally:
+            tel = get_telemetry()
+            tel.flight = None
+            tel.slo = None
+            tel.identity.clear()
+            set_telemetry(prev)
+        assert "error" not in client_out, client_out
+        assert rc == 75  # the SIGTERM -> drain -> exit-75 contract
+        hdr, flow_shape = client_out["request"]
+        assert hdr["id"] == 5 and hdr["status"] == "ok"
+        assert flow_shape == (48, 64, 2)
+        hdr, flow_shape = client_out["frame"]
+        assert hdr["id"] == 6 and hdr["status"] == "ok"
+        assert flow_shape == (48, 64, 2)
+        # Live healthz carried the replica identity the router routes on.
+        live = client_out["healthz_live"]
+        assert live["replica"] == 2
+        assert [48, 64, 1, 2] in live["warmed"]
+        assert live["stale_after_s"] == 0.5
+        # Final healthz: DRAINING, per the contract.
+        hz = json.load(open(healthz))
+        assert hz["draining"] is True and hz["overall"] == "draining"
+        # Final report: guard-clean window, every request accounted.
+        out = capsys.readouterr().out
+        report = json.loads(out.strip().splitlines()[-1])
+        assert report["interrupted"] is True
+        assert report["replica"] == 2
+        assert report["recompiles"] == 0
+        assert report["host_transfers"] == 0
+        assert report["completed"] == 1
+        assert report["stream_completed"] == 1

@@ -1,0 +1,1178 @@
+"""Fleet tier tests (raft_ncup_tpu/fleet/; docs/FLEET.md).
+
+Fast tier: topology validation, the wire protocol, the pad-arithmetic
+mirror, rendezvous routing, the ChildProcess lifecycle, supervisor
+restart/backoff/circuit-breaker logic against instant-crash children,
+and the router's shed/retry-after aggregation + failover against FAKE
+in-process replica servers speaking the real wire protocol (no jax, no
+model, sub-second).
+
+Slow tier: the chaos-pinned blast radius against REAL serve.py replica
+processes — killreplica (SIGKILL) mid-stream with bitwise surviving-
+replica parity, drainreplica with zero in-flight losses + the
+DRAINING/exit-75 contract, stallreplica through the healthz staleness
+contract, restart accounting, and the postmortem reassembly of a
+request's journey across the router hop.
+"""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_ncup_tpu.config import ServeConfig, StreamConfig
+from raft_ncup_tpu.fleet import (
+    ChildProcess,
+    FleetConfig,
+    FleetRouter,
+    ReplicaSupervisor,
+    healthz_fresh,
+    padded_shape,
+    read_healthz,
+)
+from raft_ncup_tpu.fleet.replica import (
+    BROKEN,
+    DEAD,
+    UP,
+    last_json_line,
+)
+from raft_ncup_tpu.fleet.router import rendezvous_choice
+from raft_ncup_tpu.fleet.wire import recv_msg, send_msg
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------- topology
+
+
+class TestTopology:
+    def test_paths_and_defaults(self, tmp_path):
+        cfg = FleetConfig(base_dir=str(tmp_path), n_replicas=3)
+        spec = cfg.replica(2)
+        assert spec.socket_path == str(tmp_path / "replica_2.sock")
+        assert spec.healthz_path == str(
+            tmp_path / "replica_2.healthz.json"
+        )
+        assert spec.flight_dir == str(tmp_path / "replica_2_flight")
+        assert len(cfg.replicas()) == 3
+        # The staleness contract: 2x the snapshot cadence by default.
+        assert cfg.stale_after_s == pytest.approx(
+            2.0 * cfg.snapshot_interval_s
+        )
+
+    def test_validation_rejects_bad_topologies(self, tmp_path):
+        base = str(tmp_path)
+        with pytest.raises(ValueError):
+            FleetConfig(base_dir=base, n_replicas=0)
+        with pytest.raises(ValueError):
+            FleetConfig(base_dir="")
+        with pytest.raises(ValueError):  # meshes must name every replica
+            FleetConfig(base_dir=base, n_replicas=3,
+                        meshes=((1, 1), (1, 1)))
+        with pytest.raises(ValueError):
+            FleetConfig(base_dir=base, circuit_break_after=0)
+        with pytest.raises(ValueError):
+            FleetConfig(base_dir=base, max_inflight_per_replica=0)
+        with pytest.raises(ValueError):
+            FleetConfig(base_dir=base, stale_after_factor=0.5)
+        with pytest.raises(ValueError):
+            FleetConfig(base_dir=base, max_failovers=-1)
+        with pytest.raises(ValueError):
+            FleetConfig(base_dir=base, snapshot_interval_s=0.0)
+        with pytest.raises(ValueError):
+            FleetConfig(base_dir=base, size_hw=(8, 8))
+
+    def test_replica_argv_is_the_topology(self, tmp_path):
+        """The spawn argv is DERIVED from the one config object —
+        serve/stream knobs, paths, cadence, mesh — so the supervisor,
+        bench, and a human reproducing a replica all run the same
+        thing."""
+        cfg = FleetConfig(
+            base_dir=str(tmp_path), n_replicas=2,
+            size_hw=(48, 64),
+            serve=ServeConfig(batch_sizes=(1, 2), iter_levels=(4, 2),
+                              queue_capacity=7),
+            stream=StreamConfig(capacity=3, iters=2, batch_sizes=(1, 2),
+                                frame_hw=(48, 64)),
+            meshes=((1, 1), (2, 1)),
+            extra_args=("--small", "--platform", "cpu"),
+        )
+        argv = cfg.replica_argv(1)
+        joined = " ".join(argv)
+        assert "--replica_socket " + str(tmp_path / "replica_1.sock") in joined
+        assert "--replica_index 1" in joined
+        assert "--iter_levels 4,2" in joined
+        assert "--queue_capacity 7" in joined
+        assert "--stream_capacity 3" in joined
+        assert "--mesh 2,1" in joined
+        assert "--small" in joined
+        # Request-only fleet: stream knobs absent, streams disabled.
+        cfg2 = FleetConfig(base_dir=str(tmp_path), stream=None)
+        argv2 = cfg2.replica_argv(0)
+        assert "--replica_streams" in argv2
+        assert argv2[argv2.index("--replica_streams") + 1] == "false"
+        assert "--stream_capacity" not in argv2
+
+    def test_padded_shape_matches_input_padder(self):
+        """The router's pure-host pad arithmetic must agree with the
+        real InputPadder for every (shape, divisor, bucket) it routes
+        on — a drifting mirror would mis-match warmed executables."""
+        from raft_ncup_tpu.ops.padding import InputPadder
+
+        for h, w in ((48, 64), (97, 130), (100, 100), (437, 1023)):
+            for divisor in (8, 16, 32):
+                p = InputPadder((h, w, 3), mode="sintel", divisor=divisor)
+                (t, b), (le, r) = p.pad_spec
+                assert padded_shape(h, w, divisor=divisor) == (
+                    h + t + b, w + le + r
+                )
+            for bucket in (32, 64):
+                p = InputPadder((h, w, 3), mode="sintel", bucket=bucket)
+                (t, b), (le, r) = p.pad_spec
+                assert padded_shape(h, w, bucket=bucket) == (
+                    h + t + b, w + le + r
+                )
+
+    def test_shape_key_uses_replica_mesh_divisor(self, tmp_path):
+        cfg = FleetConfig(
+            base_dir=str(tmp_path), n_replicas=2,
+            meshes=(None, (1, 2)),
+        )
+        assert cfg.pad_divisor(0) == 8
+        assert cfg.pad_divisor(1) == 16
+        assert cfg.shape_key(97, 130, 0) == (104, 136)
+        assert cfg.shape_key(97, 130, 1) == (112, 136)
+
+    def test_fleet_package_is_jax_free(self):
+        """JGL010's runtime half: importing the whole fleet package
+        must not pull jax into the process (the router must never be
+        ABLE to add a device sync)."""
+        import subprocess
+
+        code = (
+            "import sys; import raft_ncup_tpu.fleet; "
+            "import raft_ncup_tpu.fleet.router; "
+            "assert 'jax' not in sys.modules, 'jax leaked'; print('ok')"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code], cwd=_REPO,
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert out.stdout.strip() == "ok"
+
+
+# ----------------------------------------------------------------- wire
+
+
+class TestWire:
+    def _pair(self):
+        return socket.socketpair()
+
+    def test_roundtrip_header_and_arrays(self):
+        a, b = self._pair()
+        img = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+        mask = np.ones((3, 3), np.uint8)
+        send_msg(a, {"kind": "request", "id": 7, "deadline_s": 1.5},
+                 [img, mask])
+        header, arrays = recv_msg(b)
+        assert header == {"kind": "request", "id": 7, "deadline_s": 1.5}
+        np.testing.assert_array_equal(arrays[0], img)
+        np.testing.assert_array_equal(arrays[1], mask)
+        assert arrays[0].dtype == np.float32
+        a.close(), b.close()
+
+    def test_non_contiguous_array_survives(self):
+        a, b = self._pair()
+        img = np.arange(48, dtype=np.float32).reshape(4, 4, 3)[::2]
+        send_msg(a, {"kind": "x"}, [img])
+        _, arrays = recv_msg(b)
+        np.testing.assert_array_equal(arrays[0], img)
+        a.close(), b.close()
+
+    def test_clean_eof_returns_none(self):
+        a, b = self._pair()
+        a.close()
+        assert recv_msg(b) is None
+        b.close()
+
+    def test_mid_frame_eof_raises(self):
+        a, b = self._pair()
+        img = np.zeros((8, 8, 3), np.float32)
+        # Hand-build a frame and truncate it mid-payload.
+        import struct
+
+        blob = json.dumps({
+            "kind": "request",
+            "arrays": [{"shape": [8, 8, 3], "dtype": "float32"}],
+        }).encode()
+        a.sendall(struct.pack(">I", len(blob)) + blob
+                  + img.tobytes()[:10])
+        a.close()
+        with pytest.raises(ConnectionError):
+            recv_msg(b)
+        b.close()
+
+    def test_reserved_arrays_key_and_non_ndarray_rejected(self):
+        a, b = self._pair()
+        with pytest.raises(ValueError):
+            send_msg(a, {"arrays": []})
+        with pytest.raises(TypeError):
+            send_msg(a, {"kind": "x"}, [[1, 2, 3]])
+        a.close(), b.close()
+
+    def test_corrupt_length_prefix_fails_loudly(self):
+        a, b = self._pair()
+        import struct
+
+        a.sendall(struct.pack(">I", 1 << 24))  # over MAX_HEADER_BYTES
+        with pytest.raises(ValueError):
+            recv_msg(b)
+        a.close(), b.close()
+
+
+# ------------------------------------------------------------ lifecycle
+
+
+class TestChildProcess:
+    def test_spawn_reap_captures_output(self):
+        c = ChildProcess([
+            sys.executable, "-c",
+            "import json, sys; print('hello'); "
+            "print(json.dumps({'a': 1})); "
+            "print('warn', file=sys.stderr)",
+        ], name="t").spawn()
+        rc, out, err = c.reap(timeout=30)
+        assert rc == 0
+        assert "hello" in out and "warn" in err
+        assert last_json_line(out) == {"a": 1}
+
+    def test_reap_timeout_escalates_to_kill(self):
+        c = ChildProcess([
+            sys.executable, "-c", "import time; time.sleep(600)",
+        ], name="t").spawn()
+        t0 = time.monotonic()
+        rc, _, _ = c.reap(timeout=0.5)
+        assert rc == -9
+        assert time.monotonic() - t0 < 30
+
+    def test_suspend_resume_and_kill(self):
+        c = ChildProcess([
+            sys.executable, "-c", "import time; time.sleep(600)",
+        ], name="t").spawn()
+        assert c.running
+        assert c.suspend()
+        assert c.resume()
+        assert c.kill()
+        rc, _, _ = c.reap(timeout=10)
+        assert rc == -9 and not c.running
+
+    def test_last_json_line_skips_noise(self):
+        text = "noise\n{broken\n" + json.dumps({"k": 2}) + "\ntrailing\n"
+        assert last_json_line(text) == {"k": 2}
+        assert last_json_line("no json at all") is None
+
+
+class TestHealthzContract:
+    def test_freshness_is_the_2x_cadence_contract(self):
+        now = 1000.0
+        fresh = {"time_unix_s": now - 0.4}
+        stale = {"time_unix_s": now - 0.6}
+        assert healthz_fresh(fresh, 0.5, now_unix=now)
+        assert not healthz_fresh(stale, 0.5, now_unix=now)
+        assert not healthz_fresh(None, 0.5, now_unix=now)
+        assert not healthz_fresh({}, 0.5, now_unix=now)
+        assert not healthz_fresh({"time_unix_s": "x"}, 0.5, now_unix=now)
+
+    def test_read_healthz_missing_or_torn(self, tmp_path):
+        assert read_healthz(str(tmp_path / "nope.json")) is None
+        p = tmp_path / "torn.json"
+        p.write_text("{not json")
+        assert read_healthz(str(p)) is None
+
+
+# ------------------------------------ supervisor restart/circuit logic
+
+
+def _crashy_supervisor(tmp_path, **cfg_kw):
+    """Supervisor over children that exit 1 instantly — the crash-loop
+    the restart budget and circuit breaker exist for. argv_prefix
+    replaces serve.py with a stub that ignores the replica argv."""
+    cfg = FleetConfig(
+        base_dir=str(tmp_path),
+        n_replicas=1,
+        poll_interval_s=0.02,
+        restart_backoff_s=0.05,
+        restart_backoff_max_s=0.2,
+        **cfg_kw,
+    )
+    from raft_ncup_tpu.observability import Telemetry
+
+    sup = ReplicaSupervisor(
+        cfg,
+        argv_prefix=[sys.executable, "-c", "import sys; sys.exit(1)"],
+        telemetry=Telemetry(),
+    )
+    return cfg, sup
+
+
+def _pump(sup, until, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        sup.poll()
+        if until():
+            return
+        time.sleep(0.02)
+    raise AssertionError("supervisor never reached expected state")
+
+
+class TestSupervisorRobustness:
+    def test_restart_is_bounded_counted_with_backoff(self, tmp_path):
+        cfg, sup = _crashy_supervisor(
+            tmp_path, max_restarts=2, circuit_break_after=10,
+        )
+        sup.start(wait_ready=False)
+        sup._poll_stop.set()  # drive poll() deterministically
+        handle = sup.replicas[0]
+        _pump(sup, lambda: handle.state == BROKEN)
+        # Budget exhausted, every attempt counted, breaker NOT blamed.
+        assert handle.restarts == cfg.max_restarts == 2
+        assert handle.deaths == 3  # initial + one per restart
+        assert not handle.circuit_open
+        assert not handle.admittable()
+        rep = sup.report()
+        assert rep["restarts"] == 2 and rep["deaths"] == 3
+        sup.stop(drain=False)
+
+    def test_circuit_breaker_opens_after_k_consecutive(self, tmp_path):
+        cfg, sup = _crashy_supervisor(
+            tmp_path, max_restarts=10, circuit_break_after=3,
+        )
+        sup.start(wait_ready=False)
+        sup._poll_stop.set()
+        handle = sup.replicas[0]
+        _pump(sup, lambda: handle.circuit_open)
+        # K consecutive failures without an intervening READY: breaker
+        # open, no further restarts, no traffic.
+        assert handle.consecutive_failures == cfg.circuit_break_after == 3
+        assert handle.state == BROKEN
+        assert handle.restarts == 2  # the attempts BEFORE the breaker
+        assert not handle.admittable()
+        restarts_at_open = handle.restarts
+        for _ in range(5):
+            sup.poll()
+            time.sleep(0.03)
+        assert handle.restarts == restarts_at_open  # stays open
+        assert sup.report()["circuits_open"] == 1
+        sup.stop(drain=False)
+
+    def test_backoff_doubles_and_caps(self, tmp_path):
+        cfg, sup = _crashy_supervisor(
+            tmp_path, max_restarts=10, circuit_break_after=10,
+        )
+        sup.start(wait_ready=False)
+        sup._poll_stop.set()
+        handle = sup.replicas[0]
+        delays = []
+        prev_deaths = 0
+        deadline = time.monotonic() + 10
+        while len(delays) < 4 and time.monotonic() < deadline:
+            sup.poll()
+            if handle.deaths > prev_deaths and handle.state == DEAD:
+                delays.append(handle.restart_at - time.monotonic())
+                prev_deaths = handle.deaths
+            time.sleep(0.01)
+        assert len(delays) == 4
+        # 0.05, 0.1, 0.2, then capped at 0.2 (restart_backoff_max_s).
+        assert delays[1] > delays[0]
+        assert all(d <= cfg.restart_backoff_max_s + 0.02 for d in delays)
+        sup.stop(drain=False)
+
+
+# ----------------------------- router against fake in-process replicas
+
+
+class _FakeReplica:
+    """An in-process replica server speaking the real wire protocol.
+
+    ``plan`` decides each message's fate: "ok" answers with a zero
+    flow, "shed" answers shed with ``retry_after_s``, "hold" never
+    answers (a wedged replica). One behavior per message, in order;
+    the last entry repeats.
+    """
+
+    def __init__(self, spec, plan, retry_after_s=1.0):
+        self.spec = spec
+        self.plan = list(plan)
+        self.retry_after = retry_after_s
+        self.seen = []
+        self._n = 0
+        self._lsock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._lsock.bind(spec.socket_path)
+        self._lsock.listen(4)
+        self._lsock.settimeout(0.1)
+        self._stop = threading.Event()
+        self._threads = [threading.Thread(
+            target=self._accept_loop, daemon=True
+        )]
+        self._threads[0].start()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn):
+        try:
+            while True:
+                msg = recv_msg(conn)
+                if msg is None:
+                    return
+                header, arrays = msg
+                self.seen.append(header)
+                behavior = self.plan[min(self._n, len(self.plan) - 1)]
+                self._n += 1
+                if behavior == "hold":
+                    continue
+                if behavior == "shed":
+                    send_msg(conn, {
+                        "kind": "response", "id": header["id"],
+                        "status": "shed",
+                        "retry_after_s": self.retry_after,
+                        "detail": "fake shed",
+                    })
+                    continue
+                h, w = arrays[0].shape[:2]
+                send_msg(conn, {
+                    "kind": "response", "id": header["id"],
+                    "status": "ok", "iters": 2, "latency_s": 0.001,
+                    "detail": "",
+                }, [np.zeros((h, w, 2), np.float32)])
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self._stop.set()
+        self._lsock.close()
+
+
+def _fake_fleet(tmp_path, plans, retry_afters, **cfg_kw):
+    """A router over N fake replicas: supervisor handles hand-marked UP
+    (no processes), fake servers on the topology's socket paths."""
+    from raft_ncup_tpu.observability import Telemetry
+
+    cfg = FleetConfig(
+        base_dir=str(tmp_path), n_replicas=len(plans), **cfg_kw
+    )
+    sup = ReplicaSupervisor(cfg, telemetry=Telemetry())
+    fakes = []
+    for i, (plan, ra) in enumerate(zip(plans, retry_afters)):
+        fakes.append(_FakeReplica(cfg.replica(i), plan, ra))
+        sup.replicas[i].state = UP
+        sup.replicas[i].last_healthz = {"overall": "ready"}
+    router = FleetRouter(cfg, sup, telemetry=Telemetry())
+    return cfg, sup, router, fakes
+
+
+def _img(h=32, w=48, seed=0):
+    return np.random.default_rng(seed).uniform(
+        0, 255, (h, w, 3)
+    ).astype(np.float32)
+
+
+class TestRouterAgainstFakes:
+    def test_ok_roundtrip_and_least_loaded_spread(self, tmp_path):
+        cfg, sup, router, fakes = _fake_fleet(
+            tmp_path, [["ok"], ["ok"]], [1.0, 1.0],
+        )
+        try:
+            rs = [
+                router.submit(_img(), _img()).result(timeout=10)
+                for _ in range(4)
+            ]
+            assert [r.status for r in rs] == ["ok"] * 4
+            assert all(r.flow.shape == (32, 48, 2) for r in rs)
+            # Sequential submits against instant fakes drain each time;
+            # the cumulative-dispatch tie-break must still spread the
+            # load instead of pinning replica 0.
+            assert router.report()["per_replica_dispatched"] == {
+                0: 2, 1: 2,
+            }
+        finally:
+            router.drain()
+            [f.close() for f in fakes]
+
+    def test_fleet_shed_never_smaller_than_any_consulted_hint(
+        self, tmp_path
+    ):
+        """Satellite regression: the fleet-level shed's retry_after_s
+        aggregates the per-replica hints as a MAX over the replicas the
+        routing consulted — never an invented constant smaller than a
+        replica's own backpressure."""
+        cfg, sup, router, fakes = _fake_fleet(
+            tmp_path,
+            [["shed", "hold"], ["shed", "hold"]],
+            [2.5, 0.5],
+            max_inflight_per_replica=1,
+            default_retry_after_s=0.25,
+        )
+        try:
+            # One shed from each replica populates the hints: the
+            # dispatch tie-break alternates 0 then 1.
+            r0 = router.submit(_img(), _img()).result(timeout=10)
+            assert r0.status == "shed" and r0.retry_after_s >= 2.5
+            r1 = router.submit(_img(), _img()).result(timeout=10)
+            assert r1.status == "shed"
+            # Replica 1's own hint is 0.5, but the routing consulted
+            # replica 0 too (hint 2.5): the aggregate must not be
+            # smaller than EVERY consulted replica's hint.
+            assert r1.retry_after_s >= 2.5
+            router.submit(_img(), _img())  # held by replica 0 forever
+            router.submit(_img(), _img())  # held by replica 1 forever
+            # Both replicas at the inflight bound: the router sheds
+            # BEFORE the socket, aggregating both hints.
+            r2 = router.submit(_img(), _img()).result(timeout=10)
+            assert r2.status == "shed"
+            assert r2.detail.startswith("fleet at capacity")
+            assert r2.retry_after_s >= max(2.5, 0.5)
+            hints = router.report()["shed_hints"]
+            assert r2.retry_after_s >= max(hints.values())
+        finally:
+            router.drain(timeout=0.2)
+            [f.close() for f in fakes]
+
+    def test_no_admittable_replica_sheds_honestly(self, tmp_path):
+        cfg, sup, router, fakes = _fake_fleet(
+            tmp_path, [["ok"]], [1.0],
+        )
+        try:
+            sup.replicas[0].state = DEAD
+            r = router.submit(_img(), _img()).result(timeout=10)
+            assert r.status == "shed"
+            assert "no admittable replica" in r.detail
+            assert r.retry_after_s >= cfg.default_retry_after_s
+        finally:
+            router.drain(timeout=0.2)
+            [f.close() for f in fakes]
+
+    def test_stream_affinity_sticky_and_rendezvous(self, tmp_path):
+        cfg, sup, router, fakes = _fake_fleet(
+            tmp_path, [["ok"], ["ok"], ["ok"]], [1.0, 1.0, 1.0],
+        )
+        try:
+            for fi in range(3):
+                for s in ("sa", "sb", "sc", "sd"):
+                    r = router.submit(
+                        _img(), _img(), stream_id=s, frame_index=fi
+                    ).result(timeout=10)
+                    assert r.status == "ok"
+            aff = router.report()["affinity"]
+            # Sticky: every frame of a stream hit ONE replica.
+            for s, home in aff.items():
+                frames = [
+                    h for f in fakes for h in f.seen
+                    if h.get("stream_id") == s
+                ]
+                homes = {
+                    i for i, f in enumerate(fakes)
+                    if any(h.get("stream_id") == s for h in f.seen)
+                }
+                assert homes == {aff[s]}, (s, homes)
+                assert len(frames) == 3
+            # And the choice is the rendezvous hash over the live set.
+            for s, home in aff.items():
+                assert home == rendezvous_choice(s, [0, 1, 2])
+        finally:
+            router.drain()
+            [f.close() for f in fakes]
+
+    def test_rendezvous_minimal_movement(self):
+        keys = [f"stream-{i}" for i in range(50)]
+        before = {k: rendezvous_choice(k, [0, 1, 2]) for k in keys}
+        after = {k: rendezvous_choice(k, [0, 2]) for k in keys}
+        for k in keys:
+            if before[k] != 1:
+                # Only the dead replica's keys move.
+                assert after[k] == before[k]
+
+    def test_shape_aware_routing_prefers_warm_replica(self, tmp_path):
+        cfg, sup, router, fakes = _fake_fleet(
+            tmp_path, [["ok"], ["ok"]], [1.0, 1.0],
+        )
+        try:
+            # Replica 1 advertises the padded shape for 32x48 as warm.
+            sup.replicas[1].last_healthz = {
+                "overall": "ready",
+                "warmed": [[32, 48, 1, 2], [32, 48, 2, 2]],
+            }
+            for _ in range(3):
+                r = router.submit(_img(), _img()).result(timeout=10)
+                assert r.status == "ok"
+            # Every request preferred the warm replica despite equal
+            # load — the cold replica would pay a compile.
+            assert router.report()["per_replica_dispatched"] == {
+                0: 0, 1: 3,
+            }
+            # A shape NO replica has warmed falls back to least-loaded.
+            r = router.submit(
+                _img(40, 56), _img(40, 56)
+            ).result(timeout=10)
+            assert r.status == "ok"
+            assert router.report()["per_replica_dispatched"][0] == 1
+        finally:
+            router.drain()
+            [f.close() for f in fakes]
+
+    def test_draining_replica_gets_nothing_new(self, tmp_path):
+        from raft_ncup_tpu.fleet.replica import DRAINING
+
+        cfg, sup, router, fakes = _fake_fleet(
+            tmp_path, [["ok"], ["ok"]], [1.0, 1.0],
+        )
+        try:
+            sup.replicas[0].state = DRAINING
+            for _ in range(3):
+                r = router.submit(_img(), _img()).result(timeout=10)
+                assert r.status == "ok"
+            assert router.report()["per_replica_dispatched"] == {
+                0: 0, 1: 3,
+            }
+        finally:
+            router.drain()
+            [f.close() for f in fakes]
+
+    def test_failover_on_death_redispatches_within_deadline(
+        self, tmp_path
+    ):
+        cfg, sup, router, fakes = _fake_fleet(
+            tmp_path, [["hold"], ["ok"]], [1.0, 1.0],
+        )
+        try:
+            # Pin the request to replica 0 (holds forever), then declare
+            # it dead: the router must re-dispatch to replica 1 and the
+            # client sees ONE ok — no silent drop, no double answer.
+            sup.replicas[1].state = DEAD  # force routing to 0
+            h = router.submit(_img(), _img(), deadline_s=30.0)
+            time.sleep(0.1)
+            sup.replicas[1].state = UP
+            sup.replicas[0].state = DEAD
+            router._on_replica_death(0, "test kill")
+            r = h.result(timeout=10)
+            assert r.status == "ok"
+            assert router.stats["failovers"] == 1
+        finally:
+            router.drain(timeout=0.2)
+            [f.close() for f in fakes]
+
+    def test_failover_respects_deadline_and_budget(self, tmp_path):
+        cfg, sup, router, fakes = _fake_fleet(
+            tmp_path, [["hold"], ["ok"]], [1.0, 1.0],
+            max_failovers=1,
+        )
+        try:
+            sup.replicas[1].state = DEAD
+            # Deadline already unmeetable at death time: honest error,
+            # zero re-dispatch.
+            h = router.submit(_img(), _img(), deadline_s=0.05)
+            time.sleep(0.15)
+            sup.replicas[1].state = UP
+            sup.replicas[0].state = DEAD
+            router._on_replica_death(0, "test kill")
+            r = h.result(timeout=10)
+            assert r.status == "error"
+            assert "deadline expired before failover" in r.detail
+            assert router.stats["failovers"] == 0
+            assert router.stats["failover_errors"] == 1
+        finally:
+            router.drain(timeout=0.2)
+            [f.close() for f in fakes]
+
+    def test_failover_with_no_survivor_sheds_honestly(self, tmp_path):
+        cfg, sup, router, fakes = _fake_fleet(
+            tmp_path, [["hold"]], [1.0],
+        )
+        try:
+            h = router.submit(_img(), _img(), deadline_s=30.0)
+            time.sleep(0.1)
+            sup.replicas[0].state = DEAD
+            router._on_replica_death(0, "test kill")
+            r = h.result(timeout=10)
+            assert r.status == "shed"
+            assert "no admittable replica" in r.detail
+        finally:
+            router.drain(timeout=0.2)
+            [f.close() for f in fakes]
+
+    def test_router_drain_sheds_new_and_errors_stuck(self, tmp_path):
+        cfg, sup, router, fakes = _fake_fleet(
+            tmp_path, [["hold"]], [1.0],
+        )
+        try:
+            h = router.submit(_img(), _img())
+            out = router.drain(timeout=0.3)
+            r = h.result(timeout=5)
+            assert r.status == "error"  # bounded wait expired: explicit
+            r2 = router.submit(_img(), _img()).result(timeout=5)
+            assert r2.status == "shed" and "draining" in r2.detail
+            assert out["stats"]["routed"] == 1
+        finally:
+            [f.close() for f in fakes]
+
+
+class TestReplayFleetChaos:
+    def test_faults_target_the_replica_that_carried_the_submission(
+        self, tmp_path
+    ):
+        """The fleet chaos grammar's coordinate semantics: after
+        submission N dispatches, killreplica@N / stallreplica@N /
+        drainreplica@N hit the replica that CARRIED submission N —
+        deterministic because routing is."""
+        from raft_ncup_tpu.fleet import replay_fleet
+        from raft_ncup_tpu.resilience.chaos import ChaosSpec
+
+        cfg, sup, router, fakes = _fake_fleet(
+            tmp_path, [["ok"], ["ok"]], [1.0, 1.0],
+        )
+        calls = []
+        sup.kill = lambda i: calls.append(("kill", i))
+        sup.stall = lambda i: calls.append(("stall", i))
+        sup.drain = lambda i: calls.append(("drain", i)) or {}
+        try:
+            spec = ChaosSpec.parse(
+                "killreplica@1,stallreplica@2,drainreplica@3"
+            )
+            items = [
+                {"image1": _img(), "image2": _img()} for _ in range(4)
+            ]
+            handles = replay_fleet(
+                router, items, supervisor=sup, chaos=spec,
+            )
+            assert len(handles) == 4
+            for h in handles:
+                assert h.result(timeout=10).status == "ok"
+            time.sleep(0.1)  # drain thread records asynchronously
+            got = {kind: i for kind, i in calls}
+            assert set(got) == {"kill", "stall", "drain"}
+            # Each fault's target is submission N's carrier.
+            assert got["kill"] == router.replica_of(1)
+            assert got["stall"] == router.replica_of(2)
+            assert got["drain"] == router.replica_of(3)
+        finally:
+            router.drain()
+            [f.close() for f in fakes]
+
+
+# --------------------------------------------- postmortem over a fleet
+
+
+class TestFleetPostmortem:
+    def _mk_dump(self, tel_dir, walltime, spans, trigger, **context):
+        from raft_ncup_tpu.observability import Telemetry
+        from raft_ncup_tpu.observability.flight import FlightRecorder
+
+        tel = Telemetry()
+        for name, attrs in spans:
+            tel.event(name, **attrs)
+        rec = FlightRecorder(tel_dir, walltime=lambda: walltime)
+        path = rec.record(trigger, tel, **context)
+        assert path is not None
+        return path
+
+    def test_selection_by_replica_and_latest_deterministic(
+        self, tmp_path, capsys
+    ):
+        """Satellite: a fleet flight tree holds several replicas' dumps;
+        selection is by replica subtree + latest-by-filename (never
+        mtime), and the router-side correlation id attached at dispatch
+        matches the replica-side span attrs — one --request_id
+        reassembles the journey across the router hop."""
+        import importlib.util
+
+        base = tmp_path / "fleet_run"
+        rid = 41
+        # Replica 1: two dumps at different embedded timestamps; the
+        # replica-side spans carry the ROUTER's request id (FlowServer
+        # registered the request under it).
+        d_old = self._mk_dump(
+            str(base / "replica_1_flight"), 1_700_000_000.0,
+            [("serve_request_quarantined", {"request_id": 999})],
+            "poison_quarantine", request_id=999,
+        )
+        d_new = self._mk_dump(
+            str(base / "replica_1_flight"), 1_700_000_100.0,
+            [("serve_request_quarantined", {"request_id": rid,
+                                            "batch_id": 3})],
+            "poison_quarantine", request_id=rid,
+        )
+        # Replica 0 + the router's own failover dump referencing the
+        # same id from the OTHER side of the hop.
+        self._mk_dump(
+            str(base / "replica_0_flight"), 1_700_000_050.0,
+            [("serve_request_quarantined", {"request_id": 7})],
+            "poison_quarantine", request_id=7,
+        )
+        self._mk_dump(
+            str(base / "router_flight"), 1_700_000_060.0,
+            [("fleet_dispatch", {"request_id": rid, "replica": 1})],
+            "replica_failover", replica=1, request_ids=[rid],
+        )
+        # Deliberately scramble mtimes: selection must not read them.
+        for root, _, files in os.walk(base):
+            for i, f in enumerate(sorted(files)):
+                os.utime(os.path.join(root, f), (1, 1 + i))
+
+        spec = importlib.util.spec_from_file_location(
+            "postmortem", os.path.join(_REPO, "scripts", "postmortem.py")
+        )
+        pm = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pm)
+
+        # --replica narrows to that subtree; latest wins by filename.
+        assert pm.select_dump(str(base), replica=1) == d_new
+        assert pm.select_dump(str(base), replica=1) == d_new  # stable
+        with pytest.raises(FileNotFoundError):
+            pm.select_dump(str(base), replica=9)
+
+        # Full reassembly through the CLI: replica side of the hop...
+        assert pm.main([str(base), "--replica", "1",
+                        "--request_id", str(rid)]) == 0
+        out = capsys.readouterr().out
+        assert "serve_request_quarantined" in out
+        assert f"request_id={rid}" in out
+        # ...and the router side carries the SAME correlation id.
+        router_dump = pm.select_dump(str(base / "router_flight"))
+        from raft_ncup_tpu.observability import load_dump, match_records
+
+        dump = load_dump(router_dump)
+        matched = match_records(dump["spans"], request_id=rid)
+        assert any(r["name"] == "fleet_dispatch" for r in matched)
+        assert dump["context"]["request_ids"] == [rid]
+
+
+def _mesh_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""  # drop the conftest's 8-device flag
+    return env
+
+
+def _fleet_cfg(tmp_path, n=3, **kw):
+    kw.setdefault("serve", ServeConfig(
+        batch_sizes=(1, 2), iter_levels=(2,), queue_capacity=16,
+    ))
+    # idle_timeout_s is generous: on a loaded host the restart-backoff
+    # wait between chaos phases can exceed the 30s default, and an
+    # idle-evicted stream legitimately re-admits COLD — which would
+    # make the bitwise reference comparison depend on wall-clock.
+    kw.setdefault("stream", StreamConfig(
+        capacity=4, iters=2, batch_sizes=(1, 2), frame_hw=(48, 64),
+        max_frame_gap=10, idle_timeout_s=600.0,
+    ))
+    kw.setdefault("extra_args", ("--small", "--platform", "cpu"))
+    kw.setdefault("snapshot_interval_s", 0.25)
+    kw.setdefault("poll_interval_s", 0.05)
+    return FleetConfig(
+        base_dir=str(tmp_path / "fleet"), n_replicas=n,
+        size_hw=(48, 64), **kw,
+    )
+
+
+@pytest.mark.slow
+class TestFleetBlastRadius:
+    """The acceptance chaos matrix against REAL serve.py replica
+    processes: one 3-replica fleet serves mixed request+stream traffic
+    through killreplica (SIGKILL mid-stream), drainreplica (SIGTERM
+    contract), and stallreplica (healthz staleness) — with bitwise
+    surviving-replica parity against an uninjected in-process reference
+    and exact terminal-status accounting throughout."""
+
+    def test_chaos_blast_radius_kill_drain_stall(self, tmp_path):
+        from raft_ncup_tpu.observability import Telemetry
+        from raft_ncup_tpu.resilience.chaos import ChaosSpec
+
+        # The fleet chaos grammar rides the PR 5/6 machinery.
+        spec = ChaosSpec.parse(
+            "killreplica@0,drainreplica@1,stallreplica@2"
+        )
+        assert spec.active
+        assert spec.kill_replica_at == frozenset({0})
+        assert spec.drain_replica_at == frozenset({1})
+        assert spec.stall_replica_at == frozenset({2})
+        assert "killreplica@0" in spec.render()
+
+        cfg = _fleet_cfg(
+            tmp_path, n=3,
+            max_restarts=1, restart_backoff_s=0.3,
+            circuit_break_after=5,
+        )
+        tel = Telemetry(
+            flight_dir=os.path.join(cfg.base_dir, "router_flight")
+        )
+        sup = ReplicaSupervisor(cfg, env=_mesh_env(), telemetry=tel)
+        sup.start()
+        router = FleetRouter(cfg, sup, telemetry=tel)
+        rng = np.random.default_rng(7)
+        streams = ("s0", "s1", "s2", "s3")
+        frames = {
+            s: [
+                rng.uniform(0, 255, (48, 64, 3)).astype(np.float32)
+                for _ in range(7)
+            ]
+            for s in streams
+        }
+        reqs = [
+            rng.uniform(0, 255, (48, 64, 3)).astype(np.float32)
+            for _ in range(2)
+        ]
+        results: dict = {}     # (stream, fi) -> FlowResponse
+        carried: dict = {}     # (stream, fi) -> replica that answered
+        req_results = []
+        all_responses = []
+
+        def submit_frame(s, fi, wait=True):
+            with router._lock:
+                rid = router._next_id
+            h = router.submit(
+                frames[s][fi], frames[s][fi + 1],
+                stream_id=s, frame_index=fi,
+            )
+            if not wait:
+                return h, rid
+            r = h.result(timeout=180)
+            results[(s, fi)] = r
+            carried[(s, fi)] = router.replica_of(rid)
+            all_responses.append(r)
+            return r
+
+        h_stuck = None
+        try:
+            # ---- phase 1: warm mixed traffic, sequential (every batch
+            # is a single frame — bitwise-comparable to the reference).
+            for fi in range(2):
+                for s in streams:
+                    assert submit_frame(s, fi).status == "ok"
+            for img in reqs:
+                r = router.submit(img, img).result(timeout=180)
+                req_results.append(r)
+                all_responses.append(r)
+                assert r.status == "ok"
+            aff = dict(router.report()["affinity"])
+            assert set(aff.values()) <= {0, 1, 2}
+
+            # ---- phase 2: killreplica (SIGKILL, not SIGTERM) with a
+            # frame in flight: the victim is s0's home, suspended first
+            # so the in-flight frame deterministically never answers.
+            victim = aff["s0"]
+            sup.replicas[victim].child.suspend()
+            h_inflight, rid_inflight = submit_frame("s0", 2, wait=False)
+            time.sleep(0.2)
+            sup.kill(victim)  # SIGKILL; poll detects, router fails over
+            r = h_inflight.result(timeout=180)
+            results[("s0", 2)] = r
+            carried[("s0", 2)] = router.replica_of(rid_inflight)
+            all_responses.append(r)
+            # The stranded mid-stream frame failed over and completed —
+            # cold on the new home, never silently dropped.
+            assert r.status == "ok"
+            assert router.stats["failovers"] >= 1
+            new_home = router.report()["affinity"]["s0"]
+            assert new_home != victim
+            assert carried[("s0", 2)] == new_home
+            # s0 keeps streaming warm on its new home; batch-mates on
+            # surviving replicas continue their chains untouched.
+            assert submit_frame("s0", 3).status == "ok"
+            for fi in (2, 3):
+                for s in ("s1", "s2", "s3"):
+                    assert submit_frame(s, fi).status == "ok"
+            # Restart: bounded, counted, backed off — and it came back.
+            _deadline = time.monotonic() + 60
+            while time.monotonic() < _deadline:
+                if sup.replicas[victim].state == UP:
+                    break
+                time.sleep(0.1)
+            assert sup.replicas[victim].state == UP
+            assert sup.replicas[victim].restarts == 1
+            assert sup.replicas[victim].deaths == 1
+
+            # ---- phase 3: drainreplica on a live home with work in
+            # flight: zero in-flight losses, DRAINING observed in
+            # healthz, exit 75.
+            live_aff = router.report()["affinity"]
+            drain_stream = next(
+                s for s in ("s1", "s2", "s3")
+                if sup.replicas[live_aff[s]].admittable()
+            )
+            survivor = live_aff[drain_stream]
+            sup.replicas[survivor].child.suspend()
+            h1, rid1 = submit_frame(drain_stream, 4, wait=False)
+            h2, rid2 = submit_frame(drain_stream, 5, wait=False)
+            time.sleep(0.2)
+            sup.replicas[survivor].child.resume()
+            out = sup.drain(survivor)
+            assert out["observed_draining"] is True
+            assert out["returncode"] == 75
+            assert sup.replicas[survivor].contract_violations == []
+            r1 = h1.result(timeout=180)
+            r2 = h2.result(timeout=180)
+            all_responses += [r1, r2]
+            # Zero in-flight losses: both flushed through compute ON
+            # the draining replica (the router observed DRAINING only
+            # for NEW work).
+            assert r1.status == "ok" and r2.status == "ok"
+            results[(drain_stream, 4)] = r1
+            results[(drain_stream, 5)] = r2
+            carried[(drain_stream, 4)] = router.replica_of(rid1)
+            carried[(drain_stream, 5)] = router.replica_of(rid2)
+            assert carried[(drain_stream, 4)] == survivor
+            # Its final report survived the reap, guard-clean.
+            rep = out["report"]
+            assert rep is not None and rep["interrupted"] is True
+            assert rep["recompiles"] == 0
+            assert rep["host_transfers"] == 0
+            assert not sup.replicas[survivor].admittable()
+
+            # ---- phase 4: stallreplica — the process LINGERS but the
+            # heartbeat stops; the staleness contract (healthz older
+            # than stale_after_s) declares it dead and SIGKILLs it.
+            remaining = [
+                h.index for h in sup.replicas if h.admittable()
+            ]
+            assert len(remaining) == 2
+            target = remaining[0]
+            sup.stall(target)
+            assert sup.replicas[target].child.running  # lingering zombie
+            h_stuck = router.submit(
+                frames["s0"][0], frames["s0"][1],
+                stream_id="stall_probe", frame_index=0,
+            )
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if sup.replicas[target].stale_deaths >= 1:
+                    break
+                time.sleep(0.1)
+            assert sup.replicas[target].stale_deaths == 1
+            assert sup.replicas[target].deaths >= 1
+            r = h_stuck.result(timeout=180)
+            all_responses.append(r)
+            # Stall probe either failed over (it was homed on the
+            # stalled replica) or served normally — terminal either way.
+            assert r.status in ("ok", "shed")
+        finally:
+            router.drain()
+            reports = sup.stop()
+
+        # ---- exact terminal-status accounting: every submission
+        # reached exactly one terminal status (result() would have
+        # raised otherwise), none silently dropped, none server-error.
+        from raft_ncup_tpu.serving.request import TERMINAL_STATUSES
+
+        assert all(r.status in TERMINAL_STATUSES for r in all_responses)
+        n_ok = sum(1 for r in all_responses if r.status == "ok")
+        assert n_ok >= len(results) + len(req_results)
+        assert sum(
+            1 for r in all_responses if r.status == "error"
+        ) == 0
+
+        # ---- the fleet flight tree tells the same story: the router
+        # banked a replica_failover dump whose correlation ids match
+        # the replica-side span attrs (postmortem reassembles across
+        # the hop; fast-tier TestFleetPostmortem pins the selection
+        # semantics on a synthetic tree).
+        from raft_ncup_tpu.observability import load_dump, match_records
+
+        router_dumps = [
+            f for f in os.listdir(
+                os.path.join(cfg.base_dir, "router_flight")
+            )
+            if f.startswith("flight_replica_failover_")
+        ]
+        assert router_dumps
+        dump = load_dump(os.path.join(
+            cfg.base_dir, "router_flight", sorted(router_dumps)[0]
+        ))
+        assert rid_inflight in dump["context"]["request_ids"]
+        assert match_records(dump["spans"], request_id=rid_inflight)
+
+        # ---- bitwise blast radius: every surviving-replica response
+        # equals an UNINJECTED run. The reference is a fresh
+        # single-replica fleet in the SAME environment (same argv, same
+        # env, same deterministic PRNGKey(0) weights — an in-process
+        # reference would differ in the last float bits because the
+        # test process runs 8 virtual CPU devices). Each per-replica
+        # segment replays under a fresh stream id: a fresh stream is
+        # cold at the segment head, exactly what the re-homed replica's
+        # engine saw; warm within.
+        def segments(s):
+            """Consecutive same-replica runs of a stream's answered
+            frames, in frame order."""
+            fis = sorted(fi for (ss, fi) in results if ss == s)
+            segs = []
+            for fi in fis:
+                rep = carried[(s, fi)]
+                if segs and segs[-1][0] == rep:
+                    segs[-1][1].append(fi)
+                else:
+                    segs.append((rep, [fi]))
+            return segs
+
+        # Slot capacity covers every segment's fresh stream id at once
+        # (the reference never closes streams); per-row numerics are
+        # independent of the table size.
+        ref_cfg = _fleet_cfg(
+            tmp_path / "reference", n=1,
+            stream=StreamConfig(
+                capacity=12, iters=2, batch_sizes=(1, 2),
+                frame_hw=(48, 64), max_frame_gap=10,
+                idle_timeout_s=600.0,
+            ),
+        )
+        ref_sup = ReplicaSupervisor(ref_cfg, env=_mesh_env())
+        ref_sup.start()
+        ref_router = FleetRouter(ref_cfg, ref_sup)
+        try:
+            for s in streams:
+                for k, (rep_idx, fis) in enumerate(segments(s)):
+                    sid = f"{s}#seg{k}"
+                    for fi in fis:
+                        rr = ref_router.submit(
+                            frames[s][fi], frames[s][fi + 1],
+                            stream_id=sid, frame_index=fi,
+                        ).result(timeout=180)
+                        assert rr.status == "ok"
+                        np.testing.assert_array_equal(
+                            results[(s, fi)].flow, rr.flow,
+                            err_msg=f"{s} frame {fi} (replica "
+                            f"{rep_idx}) diverged from the uninjected "
+                            "reference",
+                        )
+            # Plain requests: stateless, one reference answer each.
+            for img, fleet_r in zip(reqs, req_results):
+                rr = ref_router.submit(img, img).result(timeout=180)
+                assert rr.status == "ok"
+                np.testing.assert_array_equal(fleet_r.flow, rr.flow)
+        finally:
+            ref_router.drain()
+            ref_sup.stop()
+
+        # Per-replica guard counters across every drained report: 0.
+        for idx, rep in reports.items():
+            body = rep.get("report")
+            if body is not None:
+                assert body.get("recompiles") == 0, (idx, body)
+                assert body.get("host_transfers") == 0, (idx, body)

@@ -546,3 +546,76 @@ class TestSloBlock:
             "serve_slo": self._verdicts(),
         })
         assert any("slo: serve window clean" in l for l in lines)
+
+
+class TestFleetRow:
+    """The fleet row's verdict logic (docs/FLEET.md): absent → silent,
+    any replica's guard counters dirty → unusable, robustness machinery
+    engaged → not steady state, clean → router-hop verdict vs the
+    single-replica serve row."""
+
+    def _clean(self, **kw):
+        rec = {
+            "fleet_pairs_per_sec": 3.1,
+            "fleet_p50_ms": 300.0,
+            "fleet_p99_ms": 420.0,
+            "fleet_replicas": 2,
+            "fleet_replica_recompiles": [0, 0],
+            "fleet_replica_host_transfers": [0, 0],
+            "fleet_per_replica_completed": [7, 7],
+            "fleet_shed": 0, "fleet_errors": 0, "fleet_failovers": 0,
+            "fleet_deaths": 0, "fleet_contract_violations": [],
+        }
+        rec.update(kw)
+        return rec
+
+    def test_absent_row_adds_no_lines(self):
+        assert flip._fleet_lines({}) == []
+
+    def test_any_replica_guard_counter_poisons_the_row(self):
+        lines = flip._fleet_lines(
+            self._clean(fleet_replica_recompiles=[0, 2])
+        )
+        assert len(lines) == 1 and "INVARIANT VIOLATED" in lines[0]
+        # A replica whose report never arrived is dirty too — an
+        # unaccounted replica must not read as a clean one.
+        lines = flip._fleet_lines(
+            self._clean(fleet_replica_host_transfers=[0, None])
+        )
+        assert "INVARIANT VIOLATED" in lines[0]
+
+    def test_robustness_machinery_disqualifies_steady_state(self):
+        for kw in (
+            {"fleet_shed": 1}, {"fleet_errors": 1},
+            {"fleet_failovers": 1}, {"fleet_deaths": 1},
+            {"fleet_timeouts": 1}, {"fleet_rejected": 1},
+            {"fleet_contract_violations": ["rc=1 (want 75)"]},
+            # Lossy window with every per-status field reading 0: the
+            # ok-vs-requests shortfall alone must disqualify.
+            {"fleet_requests": 12, "fleet_ok": 9},
+        ):
+            lines = flip._fleet_lines(self._clean(**kw))
+            assert len(lines) == 1 and "NOT steady state" in lines[0], kw
+        # A complete window is NOT lossy.
+        lines = flip._fleet_lines(
+            self._clean(fleet_requests=12, fleet_ok=12)
+        )
+        assert "steady state" in lines[0]
+
+    def test_clean_row_reports_router_hop_vs_serve_row(self):
+        lines = flip._fleet_lines(self._clean(serve_p50_ms=250.0))
+        assert len(lines) == 1
+        assert "steady state 3.10 pairs/s" in lines[0]
+        assert "router hop vs single-replica serve row: +50.0 ms" in lines[0]
+        assert "occupancy [7, 7]" in lines[0]
+
+    def test_clean_row_without_serve_row_says_so(self):
+        lines = flip._fleet_lines(self._clean())
+        assert "no serve row in this record" in lines[0]
+
+    def test_fleet_row_rides_cpu_records_too(self):
+        lines = flip.recommend({
+            "value": 9.0, "baseline_key": "cpu@host:volume:1x96x128x4",
+            **self._clean(),
+        })
+        assert any("fleet: steady state" in l for l in lines)

@@ -937,6 +937,86 @@ def test_parse_error_is_reported_not_raised(tmp_path):
     assert len(result.parse_errors) == 1
 
 
+# ------------------------------------------- JGL007/JGL010 fleet scope
+
+
+def test_jgl010_fleet_scope_flags_jax_and_pulls(tmp_path):
+    """The fleet control plane shares observability/'s host-only
+    contract (zero allowlist entries): a router that can touch a device
+    array can add a sync to every request it routes."""
+    dirty = """
+        import jax
+        import numpy as np
+
+        def route(request, value):
+            flow = np.asarray(value)        # implicit pull in the router
+            return jax.device_get(flow)     # explicit device access
+        """
+    findings = lint_snippet(
+        tmp_path, dirty, name="fleet/router.py", select=["JGL010"]
+    )
+    assert [f.rule for f in findings] == ["JGL010"] * 3
+
+
+def test_jgl010_fleet_scope_wire_idioms_are_clean(tmp_path):
+    """The fleet's real shape — stdlib sockets/json/signals plus
+    numpy frombuffer/tobytes on HOST arrays — is clean: the rule bans
+    the pull shapes (asarray/array/.item()/.tolist()), not numpy."""
+    clean = """
+        import json
+        import socket
+        import struct
+
+        import numpy as np
+
+        def send(sock, header, arr):
+            blob = json.dumps(header).encode()
+            sock.sendall(struct.pack(">I", len(blob)) + blob
+                         + arr.tobytes())
+
+        def recv_payload(buf, dtype, shape):
+            return np.frombuffer(buf, dtype=dtype).reshape(shape)
+        """
+    assert lint_snippet(
+        tmp_path, clean, name="fleet/wire.py", select=["JGL010"]
+    ) == []
+
+
+def test_jgl007_fleet_scope_supervisor_must_not_eat_deaths(tmp_path):
+    """A supervisor that silently eats a child's death is the exact
+    failure mode the fleet tier exists to prevent — JGL007's swallowed-
+    exception hunt covers fleet/ too."""
+    dirty = """
+        def poll(children):
+            for child in children:
+                try:
+                    child.check()
+                except Exception:
+                    pass  # a dead replica vanishes silently
+        """
+    findings = lint_snippet(
+        tmp_path, dirty, name="fleet/replica.py", select=["JGL007"]
+    )
+    assert [f.rule for f in findings] == ["JGL007"]
+    accounted = """
+        def poll(children, stats):
+            for child in children:
+                try:
+                    child.check()
+                except Exception as e:
+                    stats.note_death(child, e)  # counted, never silent
+
+        def close(sock):
+            try:
+                sock.close()
+            except OSError:
+                pass  # narrow: a decided-on drop, out of scope
+        """
+    assert lint_snippet(
+        tmp_path, accounted, name="fleet/replica.py", select=["JGL007"]
+    ) == []
+
+
 # ------------------------------------------------------------ self-check
 
 

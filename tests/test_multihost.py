@@ -90,11 +90,17 @@ def test_four_process_distributed_train_step(tmp_path):
       stubbed 2-sequence dataset, warm start on — the device splat runs
       multi-process too) writes each .flo file exactly once, from the
       main process only;
-    - exactly one process writes log.txt."""
+    - exactly one process writes log.txt.
+
+    The children ride the fleet tier's :class:`ChildProcess` lifecycle
+    (raft_ncup_tpu/fleet/replica.py) — the 4-process distributed rig
+    and the replica supervisor share ONE spawn/liveness/reap
+    implementation instead of two (docs/FLEET.md)."""
     import json
     import socket
-    import subprocess
     import sys
+
+    from raft_ncup_tpu.fleet import ChildProcess
 
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -110,25 +116,25 @@ def test_four_process_distributed_train_step(tmp_path):
     run_dir = str(tmp_path / "shared_run")
 
     procs = [
-        subprocess.Popen(
+        ChildProcess(
             [sys.executable, child, str(port), str(pid), run_dir,
              str(nprocs)],
+            name=f"dist-{pid}",
             env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            text=True,
-        )
+        ).spawn()
         for pid in range(nprocs)
     ]
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=540)
-            outs.append((p.returncode, out, err))
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+    # reap() bounds the wait and escalates to SIGKILL itself — the
+    # drain/reap half of the shared lifecycle contract. ONE deadline
+    # spans the pod: a wedged rig costs ~540 s total, not 540 s per
+    # child (the old communicate-timeout semantics, preserved).
+    import time as time_mod
+
+    deadline = time_mod.monotonic() + 540
+    outs = [
+        p.reap(timeout=max(1.0, deadline - time_mod.monotonic()))
+        for p in procs
+    ]
 
     def field(out: str, prefix: str) -> str:
         return next(

@@ -924,6 +924,24 @@ class TestFlightRecorder:
         assert tel.flight.suppressed == 1
         assert tel.counter_value("flight_dump_suppressed_total") == 1
 
+    def test_failed_write_does_not_rate_limit_the_retry(self, tmp_path):
+        """Review regression: the limiter throttles SUCCESSES — a
+        transient write failure must leave the window open, or one I/O
+        hiccup at the first fault silences the whole interval."""
+        blocker = tmp_path / "blocked"
+        blocker.write_text("a file where the dump dir should be")
+        tel = Telemetry()
+        tel.flight = FlightRecorder(str(blocker), min_interval_s=100.0)
+        assert tel.flight_dump("guard_violation") is None  # write fails
+        assert tel.flight.failed == 1
+        tel.flight.directory = str(tmp_path / "flight")  # I/O recovers
+        # Immediately retryable: NOT suppressed by the failed attempt.
+        assert tel.flight_dump("guard_violation") is not None
+        assert tel.flight.suppressed == 0
+        # A SUCCESS does arm the limiter.
+        assert tel.flight_dump("guard_violation") is None
+        assert tel.flight.suppressed == 1
+
     def test_dump_cap_deletes_oldest(self, tmp_path):
         tel = self._hub(tmp_path, max_dumps=2)
         for i in range(4):
@@ -1027,6 +1045,48 @@ class TestPeriodicSnapshotLifecycle:
         write_healthz(path, tel)
         hz = json.load(open(path, encoding="utf-8"))
         assert hz["health"] == {} and hz["slo"] is None
+
+    def test_healthz_replica_identity_schema(self, tmp_path):
+        """The fleet-facing healthz schema (docs/FLEET.md): pid +
+        process start time always present; the producer-deposited
+        identity (mesh fingerprint, warmed executable set) merged
+        verbatim; the cadence published WITH its staleness contract
+        (stale_after_s = 2x interval) so a consumer never has to guess
+        how old is dead."""
+        import os as _os
+
+        path = str(tmp_path / "hz.json")
+        tel = Telemetry()
+        tel.identity.update({
+            "replica": 3,
+            "mesh": "mesh(data=1,spatial=1)",
+            "warmed": [[48, 64, 1, 2], [48, 64, 2, 2]],
+        })
+        write_healthz(path, tel, interval_s=0.25)
+        hz = json.load(open(path, encoding="utf-8"))
+        # Replica identity: who is answering this file.
+        assert hz["pid"] == _os.getpid()
+        assert hz["start_time_unix_s"] <= hz["time_unix_s"]
+        assert hz["replica"] == 3
+        assert hz["mesh"] == "mesh(data=1,spatial=1)"
+        assert hz["warmed"] == [[48, 64, 1, 2], [48, 64, 2, 2]]
+        # The staleness contract, pinned: the writer promises the
+        # cadence, the consumer must treat 2x it as dead.
+        assert hz["interval_s"] == 0.25
+        assert hz["stale_after_s"] == 0.5
+        from raft_ncup_tpu.fleet import healthz_fresh
+
+        assert healthz_fresh(hz, hz["stale_after_s"])
+        assert not healthz_fresh(
+            hz, hz["stale_after_s"],
+            now_unix=hz["time_unix_s"] + 2.01 * hz["interval_s"],
+        )
+        # Without an interval the identity fields still land, and the
+        # cadence fields are absent rather than invented.
+        write_healthz(path, tel)
+        hz = json.load(open(path, encoding="utf-8"))
+        assert "interval_s" not in hz and "stale_after_s" not in hz
+        assert hz["pid"] == _os.getpid()
 
 
 # -------------------------------------------- prometheus compliance
@@ -1288,6 +1348,45 @@ class TestConsumersPreserveInvariants:
 
 
 class TestSloEngineReviewRegressions:
+    def test_ring_overflow_thins_resolution_not_the_window(
+        self, monkeypatch
+    ):
+        """Review regression: at a sub-second cadence (fleet replicas
+        tick every 0.25 s) a blind sample cap would evict the slow
+        window's delta base and silently compute burn_slow over
+        cap x cadence seconds instead of the DECLARED slow window. On
+        overflow the ring must halve resolution, keeping its oldest
+        in-window sample."""
+        import raft_ncup_tpu.observability.slo as slo_mod
+
+        monkeypatch.setattr(slo_mod, "_RING_CAP", 64)
+        t, clk = _clocked()
+        tel = Telemetry(clock=clk)
+        spec = SloSpec("shed", "serve", "ratio", objective=0.9,
+                       bad="bad_total", total="all_total",
+                       fast_window_s=10, slow_window_s=100,
+                       page_burn=2.0, min_events=1)
+        eng = SloEngine([spec], tel, clock=clk)
+        # A burst of bad events early, then a long clean steady state:
+        # only a full-width slow window still sees the burst's delta.
+        tel.inc("all_total", 10)
+        tel.inc("bad_total", 10)
+        for i in range(400):  # 200 s at 0.5 s cadence >> cap 64
+            t["now"] = i * 0.5
+            tel.inc("all_total", 1)  # clean traffic
+            eng.evaluate()
+        ring = eng._samples["shed"]
+        # Memory stays bounded near the cap...
+        assert len(ring) <= 2 * 64
+        # ...and the base still spans the DECLARED window: the oldest
+        # kept sample is ~100 s old, not 64 x 0.5 = 32 s.
+        now = t["now"]
+        assert now - ring[0][0] >= spec.slow_window_s * 0.8
+        # burn_slow therefore reflects the full window's clean delta,
+        # not a truncated horizon.
+        v = eng.verdicts()["shed"]
+        assert v.burn_slow < 2.0 and not v.page
+
     def test_gauge_occupancy_slo_can_actually_page(self):
         """Review regression: a gauge SLI saturates at bad_fraction 1.0,
         so its max burn is 1/(1-objective) — the declared occupancy SLO
