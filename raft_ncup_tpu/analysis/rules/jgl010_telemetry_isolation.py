@@ -27,6 +27,23 @@ enforces both statically:
   shapes the runtime guard intercepts — ``.item()`` / ``.tolist()``
   method calls and ``numpy.asarray`` / ``numpy.array`` calls.
 
+The scope covers every module in both packages — including
+``observability/aggregate.py``, the fleet trace/registry merger, which
+is exactly the kind of "offline tool" that would otherwise be tempted
+to import jax for convenience and drag a backend into every laptop
+postmortem.
+
+One more contract, specific to ``fleet/``: the **trace-context wire
+header stays optional**. The frame schema's ``trace`` field
+(``wire.TRACE_KEY``) is how cross-process trace propagation rides the
+router → replica hop, and the compatibility rule is that old peers must
+parse new frames and vice versa — so no code in ``fleet/`` may READ it
+with a mandatory subscript (``header["trace"]``); consumers use
+``.get`` (and ``TraceContext.from_wire`` tolerates ``None``). The rule
+flags Load-context ``["trace"]`` subscripts in ``fleet/`` statically;
+writing the field (``header["trace"] = ...``) is fine — a producer
+always knows its own schema.
+
 Values crossing into telemetry must already be host scalars, pulled at
 the producers' sanctioned boundaries (the AsyncDrain worker's one
 ``device_get`` per batch, the Logger's one per window);
@@ -70,6 +87,11 @@ def _in_scope(path: str) -> bool:
         f"/{d}/" in p or p.startswith(f"{d}/")
         for d in ("observability", "fleet")
     )
+
+
+def _in_fleet(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return "/fleet/" in p or p.startswith("fleet/")
 
 
 def check(ctx: ModuleContext) -> Iterator[Finding]:
@@ -135,3 +157,22 @@ def check(ctx: ModuleContext) -> Iterator[Finding]:
                     "telemetry receives host numbers, it never converts",
                     qualname(node),
                 )
+        elif (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.ctx, ast.Load)
+            and isinstance(node.slice, ast.Constant)
+            and node.slice.value == "trace"
+            and _in_fleet(ctx.path)
+        ):
+            # Wire-compat contract: the trace-context header field is
+            # OPTIONAL in every frame schema — a mandatory read would
+            # make old peers' frames unparsable by new fleet code.
+            yield Finding(
+                ctx.path, node.lineno, node.col_offset, RULE_ID,
+                "mandatory `[\"trace\"]` read in fleet/: the "
+                "trace-context wire header is OPTIONAL (old peers must "
+                "parse new frames and vice versa) — read it with "
+                "`.get('trace')` and tolerate None "
+                "(TraceContext.from_wire does)",
+                qualname(node),
+            )

@@ -44,6 +44,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 from raft_ncup_tpu.fleet import wire
 from raft_ncup_tpu.fleet.replica import ReplicaSupervisor
 from raft_ncup_tpu.fleet.topology import FleetConfig
+from raft_ncup_tpu.observability.spans import TraceContext, new_trace_id
 from raft_ncup_tpu.serving.request import (
     STATUS_ERROR,
     STATUS_SHED,
@@ -74,7 +75,7 @@ class _Pending:
     __slots__ = (
         "rid", "handle", "kind", "header", "arrays", "deadline",
         "submit_time", "replica", "failovers", "stream_id", "consulted",
-        "link",
+        "link", "trace_id", "sent_s",
     )
 
     def __init__(self, rid, handle, kind, header, arrays, deadline,
@@ -90,6 +91,12 @@ class _Pending:
         self.failovers = 0
         self.stream_id = stream_id
         self.consulted = set(consulted)
+        # One trace per request, minted at the fleet edge: the id
+        # SURVIVES failover (the re-dispatch is the same journey) and
+        # rides the wire header's optional trace context so the
+        # replica's spans adopt it (docs/OBSERVABILITY.md).
+        self.trace_id = new_trace_id()
+        self.sent_s: Optional[float] = None  # router clock at last send
         # The link incarnation that carried the dispatch: responses ride
         # the same connection, so when THIS link dies the request can
         # never be answered — even if a fresh link to the same replica
@@ -174,6 +181,17 @@ class FleetRouter:
         self._affinity: Dict[str, int] = {}
         self._shed_hints: Dict[int, float] = {}
         self._replica_of: Dict[int, int] = {}  # rid -> last replica
+        # Monotonic-clock offsets from the per-link handshake:
+        # replica_mono - router_mono, estimated as pong minus
+        # (ping + rtt/2). 0.0 until a pong answers (UDS on one host:
+        # CLOCK_MONOTONIC is shared, so 0.0 is already correct; the
+        # handshake is what keeps per-hop deltas meaningful when the
+        # wire grows a TCP multi-host transport).
+        self._clock_offsets: Dict[int, float] = {}
+        # set_fleet_telemetry ack bookkeeping (bench's fleet
+        # telemetry-overhead window toggles the replicas' hubs in place).
+        self._tel_ack_cond = threading.Condition()
+        self._tel_acks: set = set()
         self._next_id = 0
         self._draining = False
         self.stats = {
@@ -296,6 +314,11 @@ class FleetRouter:
         link = _Link(i, sock, self._on_message, self._on_link_down)
         with self._lock:
             self._links[i] = link
+        # Clock handshake: ping carries the router's monotonic clock;
+        # the pong (handled in _on_message) yields this link's offset.
+        # Fire-and-forget — a replica that predates the handshake
+        # simply never answers with t_mono and the offset stays 0.0.
+        link.send({"kind": "ping", "t0": self._clock()})
         return link
 
     # ----------------------------------------------------------- admission
@@ -370,10 +393,29 @@ class FleetRouter:
         # The router-side correlation id IS the replica-side request id:
         # the replica's FlowServer/StreamEngine register the request
         # under this exact id, so one `request_id` matches spans on both
-        # sides of the process boundary (scripts/postmortem.py).
+        # sides of the process boundary (scripts/postmortem.py) — and
+        # the trace context rides the header as an OPTIONAL field, so
+        # the replica's own spans adopt the same trace_id (old replicas
+        # ignore it; the JGL010 wire-compat check keeps it optional).
+        now = self._clock()
+        pending.sent_s = now
+        pending.header["trace"] = TraceContext(
+            trace_id=pending.trace_id,
+            span_id=f"router-{pending.rid}",
+            clock_offset_s=self._clock_offsets.get(target, 0.0),
+            sent_s=now,
+        ).to_wire()
         self._tel.event(
             "fleet_dispatch", request_id=pending.rid, replica=target,
             kind=pending.kind, stream_id=pending.stream_id,
+            trace_id=pending.trace_id,
+        )
+        # Router-queue hop: submit -> this send (routing + any failover
+        # wait). Feeds the fleet_hop_* stage breakdown in
+        # telemetry_report() alongside the wire/replica/return hops.
+        self._tel.hist_observe(
+            "fleet_hop_router_queue_ms",
+            (now - pending.submit_time) * 1e3,
         )
         link = self._link(target)
         pending.link = link
@@ -395,7 +437,29 @@ class FleetRouter:
     # ---------------------------------------------------------- responses
 
     def _on_message(self, index: int, header: dict, arrays) -> None:
-        if header.get("kind") != "response":
+        kind = header.get("kind")
+        if kind == "pong":
+            # Clock handshake answer: offset = replica_mono - router_mono,
+            # with the one-way delay approximated as rtt/2.
+            t0, t_mono = header.get("t0"), header.get("t_mono")
+            if t0 is not None and t_mono is not None:
+                now = self._clock()
+                rtt = max(0.0, now - float(t0))
+                offset = float(t_mono) - (float(t0) + rtt / 2.0)
+                with self._lock:
+                    self._clock_offsets[index] = offset
+                self._tel.event(
+                    "fleet_clock_handshake", replica=index,
+                    offset_s=round(offset, 6),
+                    rtt_ms=round(rtt * 1e3, 3),
+                )
+            return
+        if kind == "telemetry_ack":
+            with self._tel_ack_cond:
+                self._tel_acks.add(index)
+                self._tel_ack_cond.notify_all()
+            return
+        if kind != "response":
             return
         rid = header.get("id")
         with self._lock:
@@ -430,6 +494,38 @@ class FleetRouter:
         self.stats["completed"] += 1
         self._tel.hist_observe(
             "fleet_e2e_ms", (now - pending.submit_time) * 1e3
+        )
+        # Per-hop attribution (docs/OBSERVABILITY.md "Trace
+        # propagation"): the replica stamps its receive/done instants on
+        # its own monotonic clock; the handshake offset translates them
+        # onto the router's. Clamped at 0 — the offset carries up to
+        # rtt/2 of estimation error, and a hop must never read negative.
+        offset = self._clock_offsets.get(pending.replica, 0.0)
+        t_recv = header.get("t_recv_s")
+        t_done = header.get("t_done_s")
+        if t_recv is not None and pending.sent_s is not None:
+            self._tel.hist_observe(
+                "fleet_hop_wire_ms",
+                max(0.0, (float(t_recv) - offset - pending.sent_s) * 1e3),
+            )
+        if t_recv is not None and t_done is not None:
+            self._tel.hist_observe(
+                "fleet_hop_replica_ms",
+                max(0.0, (float(t_done) - float(t_recv)) * 1e3),
+            )
+        if t_done is not None:
+            self._tel.hist_observe(
+                "fleet_hop_return_ms",
+                max(0.0, (now - (float(t_done) - offset)) * 1e3),
+            )
+        # The trace's ROOT span: one ring record per completed request
+        # carrying the trace id — what aggregate.py anchors the stitched
+        # fleet tree on (and for_attr(trace_id=...) finds live).
+        self._tel.observe_ms(
+            "fleet_request", (now - pending.submit_time) * 1e3,
+            trace_id=pending.trace_id, request_id=rid,
+            replica=pending.replica, kind=pending.kind,
+            span_id=f"router-{rid}",
         )
         pending.handle.complete(FlowResponse(
             rid,
@@ -552,6 +648,7 @@ class FleetRouter:
         self._tel.event(
             "fleet_failover", request_id=p.rid, from_replica=dead,
             to_replica=target, kind=p.kind, stream_id=p.stream_id,
+            trace_id=p.trace_id,
         )
         self._dispatch(p, target)
 
@@ -568,6 +665,45 @@ class FleetRouter:
         deterministic coordinate fleet chaos targets."""
         with self._lock:
             return self._replica_of.get(rid)
+
+    def clock_offsets(self) -> Dict[int, float]:
+        """Per-replica monotonic-clock offsets from the link handshake
+        (replica_mono - router_mono) — what aggregate.py uses to
+        translate replica-side record timestamps onto the router's
+        clock when stitching the fleet trace tree."""
+        with self._lock:
+            return dict(self._clock_offsets)
+
+    def set_fleet_telemetry(
+        self, enabled: bool, timeout: float = 10.0,
+    ) -> int:
+        """Toggle every LIVE replica's telemetry hub in place over the
+        wire (the fleet analogue of ``Telemetry.enabled`` — bench's
+        fleet telemetry-overhead window flips it off and back on the
+        SAME warm fleet, so the comparison never embeds a re-warmup).
+        Returns how many replicas acked within ``timeout``; the
+        router's own hub is the caller's to flip."""
+        with self._lock:
+            targets = [
+                i for i, link in self._links.items() if link.alive
+            ]
+        with self._tel_ack_cond:
+            self._tel_acks.clear()
+        sent = set()
+        for i in targets:
+            link = self._link(i)
+            if link is not None and link.send(
+                {"kind": "set_telemetry", "enabled": bool(enabled)}
+            ):
+                sent.add(i)
+        deadline = time.monotonic() + timeout
+        with self._tel_ack_cond:
+            while not sent <= self._tel_acks:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._tel_ack_cond.wait(left)
+            return len(self._tel_acks & sent)
 
     def pending_count(self) -> int:
         with self._lock:
@@ -611,6 +747,18 @@ class FleetRouter:
                 link.sock.close()
             except OSError:
                 pass
+        # Bank the router's half of the fleet trace tree: the full span
+        # ring (every fleet_request root span + dispatch event) plus the
+        # handshake's clock offsets — exactly what aggregate.py needs to
+        # stitch this run's traces against the replicas' own drain dumps.
+        self._tel.flight_dump(
+            "router_drain",
+            stranded=len(leftovers),
+            clock_offsets={
+                str(i): round(o, 6)
+                for i, o in self.clock_offsets().items()
+            },
+        )
         return self.report()
 
     def __enter__(self) -> "FleetRouter":

@@ -50,6 +50,10 @@ class ReplicaSpec:
     socket_path: str
     healthz_path: str
     flight_dir: str
+    # Periodic registry snapshots (serve.py --telemetry_jsonl): the
+    # per-replica export observability/aggregate.py merges into the
+    # fleet-wide registry view.
+    telemetry_jsonl: str = ""
     mesh: Optional[Tuple[int, int]] = None
 
 
@@ -170,6 +174,9 @@ class FleetConfig:
                 self.base_dir, f"replica_{i}.healthz.json"
             ),
             flight_dir=os.path.join(self.base_dir, f"replica_{i}_flight"),
+            telemetry_jsonl=os.path.join(
+                self.base_dir, f"replica_{i}_telemetry.jsonl"
+            ),
             mesh=None if self.meshes is None else self.meshes[i],
         )
 
@@ -202,6 +209,7 @@ class FleetConfig:
             "--replica_index", str(i),
             "--healthz_file", spec.healthz_path,
             "--flight_dir", spec.flight_dir,
+            "--telemetry_jsonl", spec.telemetry_jsonl,
             "--telemetry_interval_s", str(self.snapshot_interval_s),
             "--size", str(self.size_hw[0]), str(self.size_hw[1]),
             "--queue_capacity", str(s.queue_capacity),

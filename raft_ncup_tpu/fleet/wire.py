@@ -15,6 +15,14 @@ JSON would triple the router's copy costs. The receiver wraps each
 payload with ``np.frombuffer`` (zero-copy, read-only — every consumer
 downstream stages/copies anyway).
 
+**Schema evolution contract**: every field beyond ``kind`` is OPTIONAL
+— in particular the cross-process trace context under ``TRACE_KEY``
+(``observability.spans.TraceContext.to_wire``). An old replica must
+parse a new router's frames (it ignores the key) and a new replica an
+old router's (``TraceContext.from_wire(header.get(TRACE_KEY))`` is
+``None``); consumers therefore read it with ``.get``, never a
+subscript — lint rule JGL010 checks that statically for ``fleet/``.
+
 Host-only stdlib + numpy (JGL010 covers ``fleet/``): the wire layer
 must never be able to touch a device array — producers hand it host
 ndarrays that were pulled at their own sanctioned boundaries.
@@ -32,6 +40,11 @@ import numpy as np
 # Sanity bound on a single header (a corrupt length prefix must fail
 # loudly, not allocate gigabytes).
 MAX_HEADER_BYTES = 1 << 20
+
+# The OPTIONAL trace-context header field (see the schema-evolution
+# contract above): request frames may carry a serialized TraceContext
+# here; response frames may echo {"trace_id": ...}.
+TRACE_KEY = "trace"
 
 _LEN = struct.Struct(">I")
 

@@ -1,0 +1,251 @@
+"""The compiled-executable cost ledger: what each warmed program
+actually costs, recorded ONCE at compile time (docs/PERF.md
+"flops_per_pair and MFU").
+
+Every bench row before this module reported ``mfu: null`` because
+nothing recorded what the compiled executables cost — the analytic
+estimate in ``utils/flops.py`` exists, but MFU against a spec-sheet
+peak is only honest when the numerator is XLA's own accounting for the
+program that actually ran. This module closes that gap with one
+declarative object (the ``FleetConfig``/``PrecisionPolicy`` pattern
+applied to cost accounting): a process-wide :class:`CostLedger` that
+``ShapeCachedForward`` feeds at warm-up/compile time and that bench,
+``scripts/flip_recommendations.py``, and the future autotuner
+(ROADMAP item 1) all read.
+
+Per warmed executable the ledger holds:
+
+- ``flops`` / ``bytes_accessed`` from ``Compiled.cost_analysis()``,
+- ``compile_ms`` (wall time of ``lower().compile()``),
+- ``memory_stats`` from ``Compiled.memory_analysis()``
+  (argument/output/temp/generated-code bytes — the
+  ``compiled_memory_stats`` surface),
+
+keyed by ``"<backend>|<executable key>"`` where the executable key is
+the SAME tuple that keys the compiled-program LRU (mesh fingerprint,
+padded shape, iters, precision fingerprint...) — so the ledger key is
+stable across re-warms by construction: same shape ⇒ same key, and a
+re-warm that hits the LRU records nothing twice.
+
+**Why this lives here and not in observability/**: reading XLA cost
+analysis requires jax, and ``observability/`` is host-only stdlib by
+lint rule JGL010 — telemetry must never be able to initialize a
+backend. The probe therefore sits WITH the inference machinery that
+already owns the compiles (``inference/pipeline.py``), runs only at
+compile time (never on the hot path — a warmed call pays one dict
+read), and hands downstream consumers plain host dicts.
+
+**MFU** = achieved FLOP/s over the chip's peak. :func:`peak_flops` is
+the per-backend peak table: TPU generations from the spec sheet
+(``utils/flops.TPU_PEAK_FLOPS``), CPU from a nominal per-core figure
+(overridable via ``RAFT_NCUP_CPU_PEAK_FLOPS``) so CPU rows report a
+real — if humbling — utilization instead of ``null``. ``None`` means
+the BACKEND is unknown, never "we didn't measure": the moment a chip
+answers, the same code path reports real MFU with zero new code.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Dict, Optional
+
+from raft_ncup_tpu.utils.flops import TPU_PEAK_FLOPS
+
+COST_LEDGER_ENV = "RAFT_NCUP_COST_LEDGER"
+CPU_PEAK_ENV = "RAFT_NCUP_CPU_PEAK_FLOPS"
+
+# Nominal peak per CPU core: 8-lane f32 FMA (AVX2) at ~3 GHz = 2 * 8 *
+# 3e9 = 4.8e10 FLOP/s. Deliberately a round spec-sheet-style constant,
+# not a microbenchmark: CPU MFU is an order-of-magnitude sanity figure
+# (documented in docs/PERF.md), and the env override exists for hosts
+# where the nominal is far off.
+CPU_PEAK_FLOPS_PER_CORE = 4.8e10
+
+_MEMORY_STAT_FIELDS = (
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "temp_size_in_bytes",
+    "alias_size_in_bytes",
+    "generated_code_size_in_bytes",
+)
+
+
+def peak_flops(
+    backend: Optional[str],
+    device_kind: Optional[str] = None,
+    tpu_gen: Optional[str] = None,
+) -> Optional[float]:
+    """Peak dense FLOP/s per chip for a backend, ``None`` only when the
+    backend (or TPU generation) is unknown. ``tpu_gen`` wins over
+    parsing ``device_kind`` (e.g. ``"TPU v5e"``)."""
+    if not backend:
+        return None
+    backend = backend.lower()
+    if backend == "cpu":
+        override = os.environ.get(CPU_PEAK_ENV)
+        if override:
+            try:
+                return float(override)
+            except ValueError:
+                pass
+        return (os.cpu_count() or 1) * CPU_PEAK_FLOPS_PER_CORE
+    if backend == "tpu":
+        gen = (tpu_gen or "").lower()
+        if not gen and device_kind:
+            m = re.search(r"v\d+[a-z]*", device_kind.lower())
+            gen = m.group(0) if m else ""
+        return TPU_PEAK_FLOPS.get(gen)
+    return None
+
+
+def mfu(
+    flops_per_item: Optional[float],
+    items_per_sec: Optional[float],
+    peak: Optional[float],
+) -> Optional[float]:
+    """Model FLOPs utilization: achieved FLOP/s over ``peak``. ``None``
+    when any input is unknown (an unknown backend, an unmeasured
+    executable) — never 0.0, which would claim a measurement."""
+    if not flops_per_item or not items_per_sec or not peak:
+        return None
+    return round(flops_per_item * items_per_sec / peak, 6)
+
+
+def probe_compiled(compiled) -> dict:
+    """Harvest one AOT-compiled executable's cost facts as a host dict:
+    ``{"flops", "bytes_accessed", "memory_stats"}``. Best-effort per
+    field — an XLA build that lacks one analysis yields ``None`` for
+    that field, never an exception (the probe must not be able to take
+    a warmup down)."""
+    out: dict = {"flops": None, "bytes_accessed": None,
+                 "memory_stats": {}}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            if ca.get("flops"):
+                out["flops"] = float(ca["flops"])
+            # XLA's key really does contain a space.
+            if ca.get("bytes accessed"):
+                out["bytes_accessed"] = float(ca["bytes accessed"])
+    except Exception:  # pragma: no cover - backend-specific
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        out["memory_stats"] = {
+            f: int(getattr(ma, f))
+            for f in _MEMORY_STAT_FIELDS
+            if getattr(ma, f, None) is not None
+        }
+    except Exception:  # pragma: no cover - backend-specific
+        pass
+    return out
+
+
+class CostLedger:
+    """Thread-safe per-process ledger of compiled-executable costs.
+
+    ``record_compiled`` is called by the compile probe exactly once per
+    (backend, executable key); re-recording the same key overwrites in
+    place (idempotent — the entry describes the executable, not the
+    event). ``meta`` carries the structured identity the consumers
+    filter on (kind/shape/iters), parsed from the executable key by the
+    probe so bench never reverse-engineers key strings.
+    """
+
+    def __init__(self, enabled: Optional[bool] = None):
+        self.enabled = (
+            os.environ.get(COST_LEDGER_ENV, "1") != "0"
+            if enabled is None else bool(enabled)
+        )
+        self._entries: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def record_compiled(
+        self, key: str, compiled, *, compile_ms: Optional[float] = None,
+        backend: Optional[str] = None, **meta,
+    ) -> Optional[dict]:
+        if not self.enabled:
+            return None
+        entry = probe_compiled(compiled)
+        entry["key"] = str(key)
+        entry["backend"] = backend
+        entry["compile_ms"] = (
+            None if compile_ms is None else round(float(compile_ms), 1)
+        )
+        entry["meta"] = {k: v for k, v in meta.items() if v is not None}
+        with self._lock:
+            self._entries[str(key)] = entry
+        return entry
+
+    # ---------------------------------------------------------- consumers
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def entry(self, key: str) -> Optional[dict]:
+        with self._lock:
+            return self._entries.get(str(key))
+
+    def keys(self) -> list:
+        with self._lock:
+            return sorted(self._entries)
+
+    def lookup(self, **meta) -> Optional[dict]:
+        """First entry whose ``meta`` matches every given item (e.g.
+        ``lookup(kind="forward", shape=(1, 96, 128, 3), iters=12)``) —
+        how bench finds the warmed headline executable's costs."""
+        with self._lock:
+            entries = list(self._entries.values())
+        for e in entries:
+            m = e.get("meta") or {}
+            if all(m.get(k) == v for k, v in meta.items()):
+                return e
+        return None
+
+    def snapshot(self) -> dict:
+        """JSON-able dump: every entry (tuples stringified) plus
+        accounting — what serve.py reports and the autotuner will read."""
+        with self._lock:
+            entries = {
+                k: {
+                    **e,
+                    "meta": {
+                        mk: (list(mv) if isinstance(mv, tuple) else mv)
+                        for mk, mv in (e.get("meta") or {}).items()
+                    },
+                }
+                for k, e in self._entries.items()
+            }
+        return {"enabled": self.enabled, "entries": entries}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+_default_lock = threading.Lock()
+_default: Optional[CostLedger] = None
+
+
+def get_cost_ledger() -> CostLedger:
+    """The process-wide default ledger (created on first use; honors
+    ``RAFT_NCUP_COST_LEDGER=0``)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = CostLedger()
+        return _default
+
+
+def set_cost_ledger(ledger: Optional[CostLedger]) -> Optional[CostLedger]:
+    """Swap the process default (bench/test isolation); returns the
+    previous ledger."""
+    global _default
+    with _default_lock:
+        prev, _default = _default, ledger
+        return prev

@@ -44,6 +44,7 @@ from __future__ import annotations
 import queue
 import sys
 import threading
+import time
 from collections import OrderedDict, deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterable, Iterator, Optional
@@ -53,6 +54,7 @@ import jax.numpy as jnp
 
 from raft_ncup_tpu.data.device_prefetch import DevicePrefetcher
 from raft_ncup_tpu.inference import metrics as metrics_mod
+from raft_ncup_tpu.inference.costs import get_cost_ledger
 from raft_ncup_tpu.observability import get_telemetry
 from raft_ncup_tpu.observability.telemetry import LEGACY_KEY_ALIASES
 from raft_ncup_tpu.precision import resolve_policy
@@ -365,7 +367,7 @@ class ShapeCachedForward:
 
     def __init__(
         self, model, variables: dict, mesh=None, cache_size: int = 8,
-        policy=None, telemetry=None,
+        policy=None, telemetry=None, cost_ledger=None,
     ):
         from raft_ncup_tpu.parallel.mesh import mesh_fingerprint
 
@@ -395,6 +397,16 @@ class ShapeCachedForward:
         # one ring event per warm batch would flood the span ring with
         # the steady state the ring exists to contextualize.
         self._tel = telemetry if telemetry is not None else get_telemetry()
+        # The executable cost ledger (inference/costs.py; docs/PERF.md):
+        # every program this cache compiles is AOT-lowered so its XLA
+        # cost analysis, compile wall time, and memory stats land in the
+        # ledger at COMPILE time — the warmed hot path pays one dict
+        # read. The ledger key embeds the same cache key, so a re-warm
+        # (LRU hit) records nothing twice.
+        self.costs = (
+            cost_ledger if cost_ledger is not None else get_cost_ledger()
+        )
+        self._backend = jax.default_backend()
 
     def model_for(self, policy=None):
         """Resolve (model, policy) for one call: the instance model when
@@ -435,17 +447,84 @@ class ShapeCachedForward:
             donate_argnums=donate,
         )
 
+    @staticmethod
+    def _ledger_meta(key: tuple) -> dict:
+        """Structured identity for the cost-ledger entry, parsed from
+        the raw (pre-mesh-fingerprint) executable key so consumers
+        filter on (kind, shape, iters) instead of string-matching keys."""
+        if key and isinstance(key[0], tuple):
+            # forward key: (shape, iters, warm, policy_fp)
+            return {"kind": "forward", "shape": key[0], "iters": key[1],
+                    "policy": key[3]}
+        if key and key[0] == "metrics":
+            # ("metrics", img_shape, flow_shape, extras, iters, kind,
+            #  pad, warm, policy_fp) — policy distinguishes the f32 and
+            # bf16 twins of one shape (they are different executables
+            # with different XLA flops; a meta lookup must not conflate
+            # them).
+            return {"kind": "metrics", "shape": key[1], "iters": key[4],
+                    "policy": key[8]}
+        if key and key[0] == "custom":
+            return {"kind": "custom"}
+        return {}
+
+    def _instrument(self, full_key: tuple, raw_key: tuple, jitfn):
+        """Wrap one freshly-built jitted program so its FIRST call
+        AOT-compiles (``lower().compile()`` — still exactly one XLA
+        compile) and banks the executable's costs in the ledger; every
+        later call is one dict read then the compiled program. Plain
+        callables (tests' stand-ins) and a disabled ledger pass through
+        untouched."""
+        if not self.costs.enabled or not hasattr(jitfn, "lower"):
+            return jitfn
+        ledger, backend = self.costs, self._backend
+        ledger_key = f"{backend}|{full_key}"
+        meta = self._ledger_meta(raw_key)
+        box: dict = {}
+        lock = threading.Lock()
+
+        def warmed(*args):
+            compiled = box.get("c")
+            if compiled is None:
+                with lock:
+                    compiled = box.get("c")
+                    if compiled is None:
+                        try:
+                            t0 = time.perf_counter()
+                            compiled = jitfn.lower(*args).compile()
+                            ledger.record_compiled(
+                                ledger_key, compiled,
+                                compile_ms=(
+                                    time.perf_counter() - t0
+                                ) * 1e3,
+                                backend=backend, **meta,
+                            )
+                        except Exception as e:  # pragma: no cover
+                            # Probe unavailable on this backend: serve
+                            # through the plain jit wrapper (no ledger
+                            # entry — `mfu` stays None, never wrong).
+                            print(
+                                f"cost probe unavailable for "
+                                f"{ledger_key}: {e!r}", file=sys.stderr,
+                            )
+                            compiled = jitfn
+                        box["c"] = compiled
+            return compiled(*args)
+
+        return warmed
+
     def _get(self, key, build):
         # Single chokepoint for key construction: every compiled-program
         # key — forward, metric, custom — carries the mesh fingerprint.
-        key = (self.mesh_fp,) + tuple(key)
+        raw_key = tuple(key)
+        key = (self.mesh_fp,) + raw_key
         fn = self._fns.get(key)
         if fn is not None:
             self._fns.move_to_end(key)
             self.stats["hits"] += 1
             self._tel.inc(_EXEC_CANON["hits"])
             return fn
-        fn = build()
+        fn = self._instrument(key, raw_key, build())
         self._fns[key] = fn
         self.stats["compiles"] += 1
         self._tel.inc(_EXEC_CANON["compiles"])

@@ -18,6 +18,14 @@ statically, ``telemetry.host_number`` at runtime, and the bench's
 telemetry-on-vs-off overhead row measures it.
 """
 
+from raft_ncup_tpu.observability.aggregate import (
+    aggregate_registry,
+    collect_fleet_records,
+    fleet_traces,
+    hop_attribution,
+    read_jsonl_tolerant,
+    render_trace,
+)
 from raft_ncup_tpu.observability.export import (
     JsonlSink,
     PeriodicSnapshot,
@@ -54,6 +62,9 @@ from raft_ncup_tpu.observability.spans import (
     NOOP_SPAN,
     Span,
     SpanTracer,
+    TraceContext,
+    new_span_id,
+    new_trace_id,
 )
 from raft_ncup_tpu.observability.telemetry import (
     DEFAULT_BUCKETS_MS,
@@ -88,13 +99,22 @@ __all__ = [
     "Span",
     "SpanTracer",
     "Telemetry",
+    "TraceContext",
     "WARMING",
+    "aggregate_registry",
+    "collect_fleet_records",
+    "fleet_traces",
     "get_telemetry",
+    "hop_attribution",
     "host_number",
     "load_dump",
     "match_records",
+    "new_span_id",
+    "new_trace_id",
     "overall_state",
     "prometheus_text",
+    "read_jsonl_tolerant",
+    "render_trace",
     "serve_slos",
     "set_telemetry",
     "stream_slos",

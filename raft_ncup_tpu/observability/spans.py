@@ -23,13 +23,29 @@ of telemetry uses; a point event feeds ``{name}_total``. xprof-side
 stage labels are NOT this module's job — the ``jax.profiler`` named
 annotations live with the jitted code they label (``models/raft.py``,
 ``parallel/step.py``, ``utils/profiling.py``).
+
+**Cross-process traces** (docs/OBSERVABILITY.md "Trace propagation"):
+a request whose life spans the fleet's router → replica hop carries a
+:class:`TraceContext` — ``trace_id`` (minted once at the fleet edge),
+the parent ``span_id``, and the sender→receiver monotonic-clock offset
+estimated by the wire handshake (``fleet/router.py``). The context is a
+plain JSON-able dict on the wire (an OPTIONAL header field: old peers
+ignore it, new peers parse old frames without it), and on each side it
+degrades to ordinary correlation attrs (``trace_id=...``) on the spans
+that already exist — ``for_attr``/``match_records`` then reassemble one
+trace across processes, and ``observability/aggregate.py`` stitches the
+exported rings into one tree. Every ring record also stamps ``t_s``
+(its start on the producer's monotonic clock) so per-hop deltas are
+computable once the clock offsets are known.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 from raft_ncup_tpu.observability.telemetry import (
@@ -40,6 +56,82 @@ from raft_ncup_tpu.observability.telemetry import (
 DEFAULT_SPAN_CAPACITY = 2048
 
 _ATTR_OK_TYPES = (str, bool, type(None))
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex trace id (host entropy; one per fleet request)."""
+    return os.urandom(8).hex()
+
+
+def new_span_id() -> str:
+    """A fresh 8-hex span id (parenting label for cross-process spans)."""
+    return os.urandom(4).hex()
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """Serializable trace context carried across a process boundary.
+
+    ``trace_id`` names the whole request journey; ``span_id`` is the
+    sender-side parent span the receiver's spans nest under;
+    ``clock_offset_s`` is the handshake's estimate of ``receiver_mono -
+    sender_mono`` (so ``sent_s + clock_offset_s`` is the send instant on
+    the RECEIVER's clock and per-hop deltas are meaningful across
+    processes); ``sent_s`` is the sender's monotonic clock at send time.
+
+    The wire form is a plain dict and deliberately OPTIONAL in every
+    frame schema: ``from_wire`` returns ``None`` for an absent or
+    malformed value, so an old peer's frames (no context) and a new
+    peer's frames (context present) both parse everywhere (JGL010's
+    wire-compat check pins the consumer side to ``.get``).
+    """
+
+    trace_id: str
+    span_id: str
+    clock_offset_s: float = 0.0
+    sent_s: Optional[float] = None
+
+    def to_wire(self) -> dict:
+        out = {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "clock_offset_s": round(float(self.clock_offset_s), 9),
+        }
+        if self.sent_s is not None:
+            out["sent_s"] = round(float(self.sent_s), 9)
+        return out
+
+    @classmethod
+    def from_wire(cls, value) -> Optional["TraceContext"]:
+        if not isinstance(value, dict):
+            return None
+        tid = value.get("trace_id")
+        if not isinstance(tid, str) or not tid:
+            return None
+        try:
+            sent = value.get("sent_s")
+            return cls(
+                trace_id=tid,
+                span_id=str(value.get("span_id") or ""),
+                clock_offset_s=float(value.get("clock_offset_s") or 0.0),
+                sent_s=None if sent is None else float(sent),
+            )
+        except (TypeError, ValueError):
+            return None
+
+    def child(self, span_id: str, *, clock_offset_s: Optional[float] = None,
+              sent_s: Optional[float] = None) -> "TraceContext":
+        """The same trace, re-parented under ``span_id`` (the next hop's
+        inbound context)."""
+        return TraceContext(
+            trace_id=self.trace_id,
+            span_id=span_id,
+            clock_offset_s=(
+                self.clock_offset_s if clock_offset_s is None
+                else clock_offset_s
+            ),
+            sent_s=sent_s,
+        )
 
 
 def _host_attr(name: str, key: str, value):
@@ -80,7 +172,15 @@ class Span:
             self.attrs[k] = _host_attr(self.name, k, v)
 
     def record(self) -> dict:
-        rec = {"name": self.name, "attrs": dict(self.attrs)}
+        # ``t_s`` is the span's start on the tracer's monotonic clock:
+        # the absolute anchor aggregate.py needs to order records and
+        # compute per-hop deltas across processes (after translating
+        # through the handshake's clock offsets).
+        rec = {
+            "name": self.name,
+            "attrs": dict(self.attrs),
+            "t_s": round(self.start_s, 6),
+        }
         if self.end_s is not None:
             rec["duration_ms"] = round(self.duration_ms, 3)
         return rec
@@ -163,7 +263,10 @@ class SpanTracer:
         checked = {
             k: _host_attr(name, k, v) for k, v in attrs.items()
         }
-        self._append({"name": name, "attrs": checked, "event": True})
+        self._append({
+            "name": name, "attrs": checked, "event": True,
+            "t_s": round(self.clock(), 6),
+        })
         if self.registry is not None:
             self.registry.counter(f"{name}_total").inc()
 
@@ -175,9 +278,11 @@ class SpanTracer:
         checked = {
             k: _host_attr(name, k, v) for k, v in attrs.items()
         }
-        self._append(
-            {"name": name, "attrs": checked, "duration_ms": round(ms, 3)}
-        )
+        self._append({
+            "name": name, "attrs": checked, "duration_ms": round(ms, 3),
+            # Start estimate: the interval ended "now" on this clock.
+            "t_s": round(self.clock() - ms / 1e3, 6),
+        })
         if self.registry is not None:
             self.registry.histogram(f"{name}_ms").observe_ms(ms)
 

@@ -84,6 +84,11 @@ class FlowRequest:
     shape_key: Optional[tuple] = None
     pad_spec: Optional[tuple] = None
     native_hw: Optional[tuple] = None
+    # Cross-process trace id adopted from an inbound TraceContext (a
+    # fleet router's wire header) — carried onto this request's spans so
+    # one trace_id reassembles the journey across the process boundary
+    # (observability/spans.py; docs/OBSERVABILITY.md).
+    trace_id: Optional[str] = None
 
 
 @dataclass

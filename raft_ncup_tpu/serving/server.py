@@ -181,6 +181,7 @@ class FlowServer:
         *,
         deadline_s: Optional[float] = None,
         request_id: Optional[int] = None,
+        trace_id: Optional[str] = None,
     ) -> ServeHandle:
         """Submit one frame pair; returns immediately with a handle.
 
@@ -191,7 +192,10 @@ class FlowServer:
         the request's identity — the replica-side spans then carry the
         router-side id verbatim, so one ``request_id`` reassembles the
         journey across the process boundary (docs/FLEET.md;
-        scripts/postmortem.py). Caller owns uniqueness.
+        scripts/postmortem.py). Caller owns uniqueness. ``trace_id``
+        adopts an inbound cross-process trace context: every span this
+        request touches carries it, so the fleet's one-trace-per-request
+        contract holds on the replica side too.
         """
         self.stats.note_submitted()
         handle = ServeHandle()
@@ -234,6 +238,7 @@ class FlowServer:
             shape_key=(h + t + b, w + le + r),
             pad_spec=padder.pad_spec,
             native_hw=(h, w),
+            trace_id=None if trace_id is None else str(trace_id),
         )
         self._handles[rid] = handle
         if not self._queue.offer(req):
@@ -330,6 +335,8 @@ class FlowServer:
                 self._tel.observe_ms(
                     "serve_queue_wait", (now - req.submit_time) * 1e3,
                     request_id=req.request_id, batch_id=token,
+                    **({"trace_id": req.trace_id}
+                       if req.trace_id is not None else {}),
                 )
                 poison = self._poison_error(req)
                 if poison is not None:
@@ -388,6 +395,7 @@ class FlowServer:
         # batch id, mesh + policy fingerprints.
         from raft_ncup_tpu.utils.profiling import stage_annotation
 
+        trace_ids = [r.trace_id for r in live if r.trace_id is not None]
         with self._tel.span(
             "serve_dispatch",
             batch_id=token,
@@ -395,6 +403,7 @@ class FlowServer:
             iters=iters,
             mesh=self._fwd.mesh_fp,
             policy=self._fwd.policy.name,
+            **({"trace_ids": trace_ids} if trace_ids else {}),
         ), stage_annotation("serve.dispatch"):
             _, flow_up = self._fwd.forward_device(img1, img2, iters)
             self._throttle.push(flow_up)
@@ -410,10 +419,12 @@ class FlowServer:
             # independent measurement flip_recommendations checks
             # against stats.batches for snapshot consistency.
             self._tel.inc("serve_drain_pulls_total")
+            tids = [r.trace_id for r in live if r.trace_id is not None]
             self._tel.observe_ms(
                 "serve_drain", (done - t_dispatch) * 1e3,
                 batch_id=token,
                 request_ids=[r.request_id for r in live],
+                **({"trace_ids": tids} if tids else {}),
             )
             for k, req in enumerate(live):
                 (t, b), (le, r) = req.pad_spec

@@ -96,6 +96,10 @@ class FrameRequest:
     submit_time: float
     pad_spec: tuple
     shape_key: Tuple[int, int]  # padded (H, W): AdmissionQueue's key_fn
+    # Cross-process trace id adopted from an inbound TraceContext (the
+    # fleet router's wire header); rides this frame's spans so one
+    # trace_id spans the router hop (observability/spans.py).
+    trace_id: Optional[str] = None
 
 
 @dataclass(eq=False)
@@ -259,6 +263,7 @@ class StreamEngine:
         *,
         frame_index: Optional[int] = None,
         request_id: Optional[int] = None,
+        trace_id: Optional[str] = None,
     ) -> ServeHandle:
         """Submit the next frame pair of ``stream_id``; returns a handle.
 
@@ -268,7 +273,8 @@ class StreamEngine:
         per stream, and a gap beyond ``max_frame_gap`` forces a cold
         start (stale warm state is never used). ``request_id`` lets a
         fleet router supply its correlation id as the frame's identity
-        (docs/FLEET.md; caller owns uniqueness).
+        (docs/FLEET.md; caller owns uniqueness); ``trace_id`` adopts the
+        router's inbound trace context onto this frame's spans.
         """
         self.stats.note("submitted")
         handle = ServeHandle()
@@ -369,6 +375,7 @@ class StreamEngine:
                 submit_time=now,
                 pad_spec=self._pad_spec_for(native_hw),
                 shape_key=(self._ph, self._pw),
+                trace_id=None if trace_id is None else str(trace_id),
             )
             self._handles[rid] = handle
             if not self._queue.offer(req):
@@ -615,6 +622,8 @@ class StreamEngine:
                 "stream_queue_wait", (now - req.submit_time) * 1e3,
                 request_id=req.request_id, stream_id=req.stream_id,
                 batch_id=token,
+                **({"trace_id": req.trace_id}
+                   if req.trace_id is not None else {}),
             )
         # First assembly of an engine that never warmed up: serving ⇒
         # READY (guarded so an SLO-driven DEGRADED is not undone here).
@@ -654,6 +663,7 @@ class StreamEngine:
 
         t_dispatch = self._clock()
         step = self._step(n_rows)
+        trace_ids = [r.trace_id for r in batch if r.trace_id is not None]
         with self._tel.span(
             "stream_dispatch",
             batch_id=token,
@@ -661,6 +671,7 @@ class StreamEngine:
             stream_ids=[r.stream_id for r in batch],
             mesh=self._fwd.mesh_fp,
             policy=self._policy.name,
+            **({"trace_ids": trace_ids} if trace_ids else {}),
         ), stage_annotation("stream.dispatch"):
             with self._table_lock:
                 self._table, flow_up, bad = step(
@@ -684,10 +695,12 @@ class StreamEngine:
             # independent count flip_recommendations checks against the
             # recorded stream_batches for snapshot consistency.
             self._tel.inc("stream_drain_pulls_total")
+            tids = [r.trace_id for r in batch if r.trace_id is not None]
             self._tel.observe_ms(
                 "stream_drain", (done - t_dispatch) * 1e3,
                 batch_id=token,
                 request_ids=[r.request_id for r in batch],
+                **({"trace_ids": tids} if tids else {}),
             )
             for k, req in enumerate(batch):
                 bad = bool(host_bad[k])

@@ -495,12 +495,32 @@ def _fleet_lines(record: dict) -> list[str]:
         "; no serve row in this record to compare the router hop against"
     )
     occ = record.get("fleet_per_replica_completed")
-    return [
+    lines = [
         f"fleet: steady state {record['fleet_pairs_per_sec']:.2f} "
         f"pairs/s over {record.get('fleet_replicas', '?')} replicas, "
         f"p50 {p50:.1f} ms / p99 {p99:.1f} ms "
         f"(per-replica guard counters all 0; occupancy {occ}){hop}"
     ]
+    # Fleet telemetry overhead (bench's on/off window over the SAME
+    # warm fleet, router + replica hubs toggled over the wire): the
+    # serve row's 3% observer budget applied at fleet granularity.
+    overhead = record.get("fleet_telemetry_overhead_pct")
+    if overhead is not None:
+        if overhead > 3.0:
+            lines.append(
+                f"fleet telemetry: tracing overhead {overhead:.1f}% of "
+                "p50 EXCEEDS the 3% budget "
+                f"(p50 {p50:.1f} ms on vs "
+                f"{record.get('fleet_p50_ms_notelemetry')} ms off) — "
+                "profile the fleet producer paths before trusting the "
+                "fleet latencies (docs/OBSERVABILITY.md)"
+            )
+        else:
+            lines.append(
+                f"fleet telemetry: measured overhead {overhead:.1f}% of "
+                "p50 (within the 3% budget)"
+            )
+    return lines
 
 
 def _serve_row_lines(record: dict) -> list[str]:

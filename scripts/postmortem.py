@@ -35,12 +35,23 @@ always selects the same dump. The router attaches its correlation id at
 dispatch as the replica-side request id, so one ``--request_id``
 reassembles the journey across the router hop.
 
+``--tree`` (directory input) additionally renders the STITCHED fleet
+trace tree: every dump in the tree is merged by ``trace_id``
+(observability/aggregate.py), replica timestamps are translated onto
+the router's clock through the handshake offsets banked in the
+``router_drain`` dump, and each trace prints as one cross-process
+timeline — router root span, wire hop, replica admission/dispatch/drain
+— with the per-hop latency breakdown. Torn dumps and truncated JSONL
+lines (a replica killed mid-write) are skipped and counted, never
+raised.
+
 Usage:
     python scripts/postmortem.py flight_poison_quarantine_*.json
     python scripts/postmortem.py dump.json --request_id 12
     python scripts/postmortem.py dump.json --stream_id s3 \
         --telemetry_jsonl serve_telemetry.jsonl
     python scripts/postmortem.py fleet_run_dir/ --replica 1 --request_id 7
+    python scripts/postmortem.py fleet_run_dir/ --tree --request_id 7
 """
 
 from __future__ import annotations
@@ -52,6 +63,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from raft_ncup_tpu.observability.aggregate import (  # noqa: E402
+    dump_sort_key as _dump_sort_key,
+)
 from raft_ncup_tpu.observability.flight import (  # noqa: E402
     load_dump,
     match_records,
@@ -61,29 +75,22 @@ from raft_ncup_tpu.observability.flight import (  # noqa: E402
 # preference order (a request id is the most specific journey).
 _CONTEXT_KEYS = ("request_id", "stream_id", "batch_id")
 
-
-def _dump_sort_key(path: str):
-    """Deterministic recency order for ``flight_<trigger>_<ts>_<seq>``
-    names: the embedded (timestamp, sequence) pair. Filesystem mtime
-    would make 'latest' depend on copy/checkout order; the name never
-    does. Unparsable names sort oldest."""
-    stem = os.path.basename(path)
-    if stem.endswith(".json"):
-        stem = stem[: -len(".json")]
-    parts = stem.split("_")
-    if len(parts) >= 3:
-        ts, seq = parts[-2], parts[-1]
-        if seq.isdigit():
-            return (1, ts, int(seq), stem)
-    return (0, "", 0, stem)
+# Deterministic recency order for flight_<trigger>_<ts>_<seq> names:
+# the ONE shared implementation (aggregate.dump_sort_key) — the
+# aggregator's latest-dump choice and this tool's selection must never
+# disagree about which dump is "latest".
 
 
 def select_dump(tree: str, replica=None) -> str:
     """Pick ONE dump from a fleet flight tree: restrict to
     ``replica_<i>_flight/`` when ``--replica`` is given, then take the
-    latest by the filename's (timestamp, seq). Raises with the
-    candidate roster when nothing matches — an empty postmortem must
-    say why."""
+    latest by the filename's (timestamp, seq) — falling back to the
+    next-latest when the newest file is torn (a replica killed mid-run
+    can leave a truncated dump; the postmortem of that very fault must
+    not raise on its evidence). Raises with the candidate roster when
+    nothing matches — an empty postmortem must say why."""
+    from raft_ncup_tpu.observability.aggregate import load_dump_tolerant
+
     roots = []
     if replica is not None:
         sub = os.path.join(tree, f"replica_{replica}_flight")
@@ -107,7 +114,16 @@ def select_dump(tree: str, replica=None) -> str:
         raise FileNotFoundError(
             f"no flight_*.json dumps under {roots}"
         )
-    return max(candidates, key=_dump_sort_key)
+    for path in sorted(candidates, key=_dump_sort_key, reverse=True):
+        if load_dump_tolerant(path) is not None:
+            return path
+        print(
+            f"skipping torn/unreadable dump {os.path.basename(path)}",
+            file=sys.stderr,
+        )
+    raise FileNotFoundError(
+        f"every flight_*.json under {roots} is torn/unreadable"
+    )
 
 
 def _pick_correlation(args, context: dict) -> dict:
@@ -190,6 +206,44 @@ def _print_snapshot_timeline(path: str, subsystems) -> None:
             )
 
 
+def _print_fleet_tree(tree: str, args) -> int:
+    """The stitched fleet trace tree (--tree): merge the router's and
+    every replica's latest dumps (observability/aggregate.py), translate
+    replica timestamps through the handshake's clock offsets, and print
+    one cross-process timeline per trace — root router span down to the
+    replica device spans — with the per-hop breakdown. Next to the
+    flight-tree view, not instead of it: the dump view is one process's
+    ring, this is the fleet's."""
+    from raft_ncup_tpu.observability.aggregate import (
+        collect_fleet_records,
+        fleet_traces,
+        render_trace,
+    )
+
+    collected = collect_fleet_records(tree)
+    traces = fleet_traces(
+        collected,
+        request_id=args.request_id,
+    )
+    print(
+        f"\nfleet trace tree ({tree}): {len(traces)} trace(s), "
+        f"origins={sorted(collected['origins'])}, "
+        f"gaps={collected['gaps']}, "
+        f"skipped_dumps={collected['skipped_dumps']}"
+    )
+    for trace in traces:
+        for line in render_trace(trace):
+            print(line)
+    if not traces:
+        print(
+            "no cross-process traces found — the run predates trace "
+            "propagation, or the rings aged the journey out before "
+            "the dumps", file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="Reassemble a request/stream journey from a "
@@ -204,6 +258,11 @@ def main(argv=None) -> int:
     parser.add_argument("--replica", type=int, default=None,
                         help="[directory input] select the dump from "
                         "this replica's replica_<i>_flight/ subtree")
+    parser.add_argument("--tree", action="store_true",
+                        help="[directory input] additionally render the "
+                        "stitched FLEET trace tree: router root spans "
+                        "down to replica device spans, per-hop "
+                        "breakdown (observability/aggregate.py)")
     parser.add_argument("--telemetry_jsonl", default=None,
                         help="serve.py --telemetry_jsonl file: print the "
                         "condensed health/SLO/queue timeline around the "
@@ -214,8 +273,8 @@ def main(argv=None) -> int:
     if os.path.isdir(dump_path):
         dump_path = select_dump(dump_path, replica=args.replica)
         print(f"selected dump: {os.path.relpath(dump_path, args.dump)}")
-    elif args.replica is not None:
-        print("--replica only applies to a directory input",
+    elif args.replica is not None or args.tree:
+        print("--replica/--tree only apply to a directory input",
               file=sys.stderr)
         return 2
     dump = load_dump(dump_path)
@@ -245,10 +304,13 @@ def main(argv=None) -> int:
     print()
     match = _pick_correlation(args, context)
     n = _print_journey(dump.get("spans", []), match)
+    tree_rc = _print_fleet_tree(args.dump, args) if args.tree else 0
     if args.telemetry_jsonl:
         _print_snapshot_timeline(
             args.telemetry_jsonl, set(health) or None
         )
+    if tree_rc:
+        return tree_rc
     if n == 0:
         print("no records matched — wrong id, or the journey aged out "
               "of the bounded ring before the dump", file=sys.stderr)

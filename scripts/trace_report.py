@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""The on-call "why is p99 up" tool: print the slowest N traces from a
+fleet export directory with their per-hop latency breakdown.
+
+Reads the same export tree a fleet run leaves under its topology
+``base_dir`` — the router's flight dumps (``fleet_request`` root spans,
+clock-handshake offsets) and each replica's ``replica_<i>_flight/``
+dumps — stitches them by ``trace_id`` (observability/aggregate.py), and
+ranks by end-to-end latency. The hop columns answer the attribution
+question directly: a p99 regression that lives in ``replica_queue`` is
+an admission/batching problem, one in ``device`` is a compute problem,
+one in ``wire``/``return`` is the transport — three different pages.
+
+Host-only stdlib, like everything it reads (the aggregate module is
+inside JGL010's scope): runnable on a laptop from the export directory,
+no jax, no backend.
+
+Usage:
+    python scripts/trace_report.py fleet_run_dir/
+    python scripts/trace_report.py fleet_run_dir/ --top 5
+    python scripts/trace_report.py fleet_run_dir/ --request_id 7 --tree
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_ncup_tpu.observability.aggregate import (  # noqa: E402
+    collect_fleet_records,
+    fleet_traces,
+    render_trace,
+)
+
+_HOP_COLUMNS = (
+    ("router_queue_ms", "router_q"),
+    ("wire_ms", "wire"),
+    ("replica_queue_ms", "replica_q"),
+    ("device_ms", "device"),
+    ("return_ms", "return"),
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Slowest-N fleet traces with per-hop breakdown"
+    )
+    parser.add_argument("export_dir", help="fleet run base_dir (router "
+                        "+ replica flight dumps)")
+    parser.add_argument("--top", type=int, default=10,
+                        help="how many traces to print (slowest first)")
+    parser.add_argument("--request_id", type=int, default=None,
+                        help="narrow to one request's trace")
+    parser.add_argument("--tree", action="store_true",
+                        help="also print each trace's full stitched "
+                        "timeline, not just the hop columns")
+    args = parser.parse_args(argv)
+
+    if not os.path.isdir(args.export_dir):
+        print(f"{args.export_dir}: not a directory", file=sys.stderr)
+        return 2
+    collected = collect_fleet_records(args.export_dir)
+    traces = fleet_traces(collected, request_id=args.request_id)
+    print(
+        f"{args.export_dir}: {len(traces)} trace(s) across "
+        f"{sorted(collected['origins'])}"
+        + (f", gaps={collected['gaps']}" if collected["gaps"] else "")
+        + (
+            f", skipped_dumps={collected['skipped_dumps']}"
+            if collected["skipped_dumps"] else ""
+        )
+    )
+    if not traces:
+        print(
+            "no traces found — not a fleet export dir, or the run "
+            "predates trace propagation", file=sys.stderr,
+        )
+        return 1
+
+    header = (
+        f"{'trace':<18} {'rid':>5} {'total':>9}  "
+        + "  ".join(f"{label:>9}" for _, label in _HOP_COLUMNS)
+    )
+    print(header)
+    print("-" * len(header))
+    for trace in traces[: max(1, args.top)]:
+        hops = trace.get("hops") or {}
+        total = trace.get("total_ms")
+        cols = "  ".join(
+            f"{hops[k]:>7.1f}ms" if k in hops else f"{'--':>9}"
+            for k, _ in _HOP_COLUMNS
+        )
+        print(
+            f"{trace['trace_id']:<18} "
+            f"{str(trace.get('request_id')):>5} "
+            + (f"{total:>7.1f}ms" if total is not None else f"{'--':>9}")
+            + f"  {cols}"
+        )
+        if args.tree:
+            for line in render_trace(trace):
+                print("  " + line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

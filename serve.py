@@ -296,9 +296,14 @@ def run_stream(args, model, variables) -> int:
         "slo": tel.slo.snapshot() if tel.slo is not None else None,
     }
     if args.report:
+        from raft_ncup_tpu.inference.costs import get_cost_ledger
         from raft_ncup_tpu.observability import telemetry_report
 
         report["telemetry"] = telemetry_report()
+        # The executable cost ledger (inference/costs.py): per-warmed-
+        # executable flops/bytes/compile-time/memory-stats — host dicts
+        # recorded at compile time, no sync to read.
+        report["cost_ledger"] = get_cost_ledger().snapshot()
     print(json.dumps(report), flush=True)
     if interrupted:
         print(
@@ -401,7 +406,7 @@ def run_replica(args, model, variables) -> int:
     )
     conns: list = []
 
-    def respond(conn, send_lock, rid, handle) -> None:
+    def respond(conn, send_lock, rid, handle, t_recv, trace_id) -> None:
         """Wait for one request's terminal response and wire it back
         (each handle completes exactly once; the drain flush completes
         every admitted handle, so the bounded wait only trips if the
@@ -418,7 +423,15 @@ def run_replica(args, model, variables) -> int:
             "latency_s": None if r is None else r.latency_s,
             "retry_after_s": None if r is None else r.retry_after_s,
             "detail": "replica response timeout" if r is None else r.detail,
+            # Per-hop timing stamps on THIS replica's monotonic clock
+            # (receive -> done); the router translates them through the
+            # handshake offset into fleet_hop_wire/replica/return_ms.
+            # Optional fields: an old router just ignores them.
+            "t_recv_s": t_recv,
+            "t_done_s": time.monotonic(),
         }
+        if trace_id is not None:
+            header["trace"] = {"trace_id": trace_id}
         arrays = (r.flow,) if (r is not None and r.flow is not None) else ()
         try:
             with send_lock:
@@ -429,24 +442,68 @@ def run_replica(args, model, variables) -> int:
             tel.inc("replica_response_undeliverable_total")
 
     def serve_conn(conn) -> None:
+        from raft_ncup_tpu.observability.spans import TraceContext
+
         send_lock = threading.Lock()
         try:
             while True:
                 msg = recv_msg(conn)
                 if msg is None:
                     break
+                t_recv = time.monotonic()
                 header, arrays = msg
                 kind = header.get("kind")
                 if kind == "ping":
+                    # Clock handshake: echo the router's t0 and stamp
+                    # our monotonic clock, so the router can estimate
+                    # replica_mono - router_mono (rtt-halved).
                     with send_lock:
-                        send_msg(conn, {"kind": "pong", "pid": os.getpid()})
+                        send_msg(conn, {
+                            "kind": "pong", "pid": os.getpid(),
+                            "t0": header.get("t0"),
+                            "t_mono": time.monotonic(),
+                        })
+                    continue
+                if kind == "set_telemetry":
+                    # Bench's fleet telemetry-overhead window: flip the
+                    # hub in place on the warm replica (the same
+                    # Telemetry.enabled bool the serve row flips
+                    # in-process). Guards and product stats keep
+                    # counting either way.
+                    tel.enabled = bool(header.get("enabled", True))
+                    with send_lock:
+                        send_msg(conn, {
+                            "kind": "telemetry_ack",
+                            "enabled": tel.enabled,
+                            "replica": args.replica_index,
+                        })
                     continue
                 rid = int(header.get("id", -1))
+                # Adopt the inbound trace context (an OPTIONAL header
+                # field — frames without it parse identically): the
+                # replica's admission/batch/device spans then carry the
+                # router's trace_id, and the measured wire hop lands as
+                # a replica-side span under the same trace.
+                ctx = TraceContext.from_wire(header.get("trace"))
+                tid = None
+                if ctx is not None:
+                    tid = ctx.trace_id
+                    if ctx.sent_s is not None:
+                        tel.observe_ms(
+                            "fleet_wire_hop",
+                            max(0.0, (t_recv - (ctx.sent_s
+                                                + ctx.clock_offset_s))
+                                * 1e3),
+                            trace_id=tid, request_id=rid,
+                            parent_span_id=ctx.span_id,
+                            replica=args.replica_index,
+                        )
                 if kind == "request" and len(arrays) == 2:
                     handle = server.submit(
                         arrays[0], arrays[1],
                         deadline_s=header.get("deadline_s"),
                         request_id=rid,
+                        trace_id=tid,
                     )
                 elif kind == "frame" and len(arrays) == 2:
                     if engine is None:
@@ -463,6 +520,7 @@ def run_replica(args, model, variables) -> int:
                         arrays[0], arrays[1],
                         frame_index=header.get("frame_index"),
                         request_id=rid,
+                        trace_id=tid,
                     )
                 else:
                     with send_lock:
@@ -472,7 +530,8 @@ def run_replica(args, model, variables) -> int:
                             "detail": f"bad message kind {kind!r}",
                         })
                     continue
-                pool.submit(respond, conn, send_lock, rid, handle)
+                pool.submit(respond, conn, send_lock, rid, handle,
+                            t_recv, tid)
         except (ConnectionError, OSError, ValueError) as e:
             print(f"replica connection dropped: {e!r}", file=sys.stderr)
         finally:
@@ -556,9 +615,14 @@ def run_replica(args, model, variables) -> int:
         report["stream_errors"] = estats.errors
         report["stream_report"] = engine.report()
     if args.report:
+        from raft_ncup_tpu.inference.costs import get_cost_ledger
         from raft_ncup_tpu.observability import telemetry_report
 
         report["telemetry"] = telemetry_report()
+        # The executable cost ledger (inference/costs.py): per-warmed-
+        # executable flops/bytes/compile-time/memory-stats — host dicts
+        # recorded at compile time, no sync to read.
+        report["cost_ledger"] = get_cost_ledger().snapshot()
     print(json.dumps(report), flush=True)
     if interrupted:
         print(
@@ -671,9 +735,14 @@ def main(argv=None) -> int:
         "slo": tel.slo.snapshot() if tel.slo is not None else None,
     }
     if args.report:
+        from raft_ncup_tpu.inference.costs import get_cost_ledger
         from raft_ncup_tpu.observability import telemetry_report
 
         report["telemetry"] = telemetry_report()
+        # The executable cost ledger (inference/costs.py): per-warmed-
+        # executable flops/bytes/compile-time/memory-stats — host dicts
+        # recorded at compile time, no sync to read.
+        report["cost_ledger"] = get_cost_ledger().snapshot()
     print(json.dumps(report), flush=True)
     if interrupted:
         print(

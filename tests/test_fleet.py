@@ -187,6 +187,28 @@ class TestWire:
         assert arrays[0].dtype == np.float32
         a.close(), b.close()
 
+    def test_trace_context_header_is_optional_both_ways(self):
+        """Wire-compat contract (JGL010's static check is the other
+        half): a frame WITH the trace-context field round-trips it
+        verbatim; a frame WITHOUT it parses identically — old and new
+        peers interoperate in both directions."""
+        from raft_ncup_tpu.fleet.wire import TRACE_KEY
+        from raft_ncup_tpu.observability import TraceContext
+
+        a, b = self._pair()
+        img = np.zeros((2, 4, 3), np.float32)
+        ctx = TraceContext("feed1234beef5678", "router-3", 0.25, 9.5)
+        send_msg(a, {"kind": "request", "id": 3, TRACE_KEY: ctx.to_wire()},
+                 [img, img])
+        header, _ = recv_msg(b)
+        assert TraceContext.from_wire(header.get(TRACE_KEY)) == ctx
+        # Old-router frame: no trace key; the tolerant parse is None.
+        send_msg(a, {"kind": "request", "id": 4}, [img, img])
+        header, _ = recv_msg(b)
+        assert TRACE_KEY not in header
+        assert TraceContext.from_wire(header.get(TRACE_KEY)) is None
+        a.close(), b.close()
+
     def test_non_contiguous_array_survives(self):
         a, b = self._pair()
         img = np.arange(48, dtype=np.float32).reshape(4, 4, 3)[::2]
@@ -410,6 +432,7 @@ class _FakeReplica:
         self.spec = spec
         self.plan = list(plan)
         self.retry_after = retry_after_s
+        self.telemetry_enabled = True
         self.seen = []
         self._n = 0
         self._lsock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -442,7 +465,28 @@ class _FakeReplica:
                 msg = recv_msg(conn)
                 if msg is None:
                     return
+                t_recv = time.monotonic()
                 header, arrays = msg
+                kind = header.get("kind")
+                if kind == "ping":
+                    # Clock handshake (control traffic never consumes a
+                    # plan entry): echo t0, stamp our monotonic clock.
+                    send_msg(conn, {
+                        "kind": "pong", "pid": os.getpid(),
+                        "t0": header.get("t0"),
+                        "t_mono": time.monotonic(),
+                    })
+                    continue
+                if kind == "set_telemetry":
+                    self.telemetry_enabled = bool(
+                        header.get("enabled", True)
+                    )
+                    send_msg(conn, {
+                        "kind": "telemetry_ack",
+                        "enabled": self.telemetry_enabled,
+                        "replica": self.spec.index,
+                    })
+                    continue
                 self.seen.append(header)
                 behavior = self.plan[min(self._n, len(self.plan) - 1)]
                 self._n += 1
@@ -461,6 +505,10 @@ class _FakeReplica:
                     "kind": "response", "id": header["id"],
                     "status": "ok", "iters": 2, "latency_s": 0.001,
                     "detail": "",
+                    # Per-hop stamps on the fake's clock, like a real
+                    # replica (router translates via the handshake).
+                    "t_recv_s": t_recv,
+                    "t_done_s": time.monotonic(),
                 }, [np.zeros((h, w, 2), np.float32)])
         except (ConnectionError, OSError, ValueError):
             pass
@@ -735,6 +783,141 @@ class TestRouterAgainstFakes:
             [f.close() for f in fakes]
 
 
+class TestTracePropagation:
+    """Cross-process tracing at the router (fast tier, fake replicas):
+    one trace per request on the wire, the clock handshake, the per-hop
+    histograms, and the fleet-wide telemetry toggle."""
+
+    def test_dispatch_carries_one_trace_per_request(self, tmp_path):
+        cfg, sup, router, fakes = _fake_fleet(
+            tmp_path, [["ok"]], [1.0],
+        )
+        try:
+            r1 = router.submit(_img(), _img()).result(timeout=10)
+            r2 = router.submit(_img(), _img()).result(timeout=10)
+            assert r1.status == r2.status == "ok"
+            seen = fakes[0].seen
+            assert len(seen) == 2
+            from raft_ncup_tpu.observability import TraceContext
+
+            ctxs = [TraceContext.from_wire(h.get("trace")) for h in seen]
+            assert all(c is not None for c in ctxs)
+            # Distinct requests, distinct traces; sender clock stamped.
+            assert ctxs[0].trace_id != ctxs[1].trace_id
+            assert all(c.sent_s is not None for c in ctxs)
+            assert [c.span_id for c in ctxs] == [
+                f"router-{h['id']}" for h in seen
+            ]
+            # The router's ring holds ONE root span per request, each
+            # carrying its wire trace id verbatim.
+            roots = router._tel.tracer.records("fleet_request")
+            assert sorted(r["attrs"]["trace_id"] for r in roots) == \
+                sorted(c.trace_id for c in ctxs)
+            # …and the journey reassembles by trace id: root + dispatch.
+            journey = router._tel.tracer.for_attr(
+                trace_id=ctxs[0].trace_id
+            )
+            assert {r["name"] for r in journey} == {
+                "fleet_dispatch", "fleet_request",
+            }
+        finally:
+            router.drain()
+            [f.close() for f in fakes]
+
+    def test_handshake_offset_and_hop_histograms(self, tmp_path):
+        """The ping/pong handshake lands a per-replica clock offset
+        (≈0 on one host — both processes share CLOCK_MONOTONIC) and the
+        response stamps produce non-negative per-hop histograms that
+        surface in telemetry_report()['stages']."""
+        cfg, sup, router, fakes = _fake_fleet(
+            tmp_path, [["ok"]], [1.0],
+        )
+        try:
+            assert router.submit(_img(), _img()).result(
+                timeout=10
+            ).status == "ok"
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if router.clock_offsets():
+                    break
+                time.sleep(0.01)
+            offsets = router.clock_offsets()
+            assert set(offsets) == {0}
+            assert abs(offsets[0]) < 0.25  # same host, same clock
+            from raft_ncup_tpu.observability import telemetry_report
+
+            stages = telemetry_report(router._tel)["stages"]
+            for hop in ("fleet_hop_router_queue", "fleet_hop_wire",
+                        "fleet_hop_replica", "fleet_hop_return",
+                        "fleet_request"):
+                assert hop in stages, sorted(stages)
+                assert stages[hop]["count"] >= 1
+                assert stages[hop]["p50_ms"] >= 0.0
+        finally:
+            router.drain()
+            [f.close() for f in fakes]
+
+    def test_set_fleet_telemetry_toggles_replicas_in_place(
+        self, tmp_path
+    ):
+        cfg, sup, router, fakes = _fake_fleet(
+            tmp_path, [["ok"], ["ok"]], [1.0, 1.0],
+        )
+        try:
+            # Establish links first (the toggle rides live links).
+            for _ in range(2):
+                assert router.submit(_img(), _img()).result(
+                    timeout=10
+                ).status == "ok"
+            acked = router.set_fleet_telemetry(False, timeout=5.0)
+            assert acked == 2
+            assert all(not f.telemetry_enabled for f in fakes)
+            acked = router.set_fleet_telemetry(True, timeout=5.0)
+            assert acked == 2
+            assert all(f.telemetry_enabled for f in fakes)
+        finally:
+            router.drain()
+            [f.close() for f in fakes]
+
+    def test_router_drain_banks_tree_with_clock_offsets(self, tmp_path):
+        """router.drain() dumps the router's half of the fleet trace
+        tree — ring + clock offsets — where aggregate.py expects it."""
+        from raft_ncup_tpu.observability import (
+            Telemetry,
+            collect_fleet_records,
+            fleet_traces,
+        )
+
+        cfg = FleetConfig(base_dir=str(tmp_path), n_replicas=1)
+        sup = ReplicaSupervisor(cfg, telemetry=Telemetry())
+        fakes = [_FakeReplica(cfg.replica(0), ["ok"], 1.0)]
+        sup.replicas[0].state = UP
+        sup.replicas[0].last_healthz = {"overall": "ready"}
+        tel = Telemetry(
+            flight_dir=os.path.join(str(tmp_path), "router_flight")
+        )
+        router = FleetRouter(cfg, sup, telemetry=tel)
+        try:
+            assert router.submit(_img(), _img()).result(
+                timeout=10
+            ).status == "ok"
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not router.clock_offsets():
+                time.sleep(0.01)
+        finally:
+            router.drain()
+            [f.close() for f in fakes]
+        collected = collect_fleet_records(str(tmp_path))
+        assert "router" in collected["origins"]
+        assert 0 in collected["clock_offsets"]
+        traces = fleet_traces(collected)
+        assert len(traces) == 1
+        assert traces[0]["origins"] == ["router"]
+        # The fake exported no ring (no real replica): it is a GAP the
+        # tree names, not a silent absence.
+        assert collected["gaps"] == [0]
+
+
 class TestReplayFleetChaos:
     def test_faults_target_the_replica_that_carried_the_submission(
         self, tmp_path
@@ -863,6 +1046,42 @@ class TestFleetPostmortem:
         matched = match_records(dump["spans"], request_id=rid)
         assert any(r["name"] == "fleet_dispatch" for r in matched)
         assert dump["context"]["request_ids"] == [rid]
+
+    def test_selection_falls_back_past_torn_latest_dump(
+        self, tmp_path, capsys
+    ):
+        """Satellite fix: a replica killed mid-run can leave the NEWEST
+        file in its flight dir truncated (copies, foreign tooling —
+        the recorder's own writes are atomic). Selection used to raise
+        on it; now it warns and falls back to the newest PARSABLE
+        dump."""
+        import importlib.util
+
+        base = tmp_path / "fleet_run"
+        good = self._mk_dump(
+            str(base / "replica_0_flight"), 1_700_000_000.0,
+            [("serve_request_quarantined", {"request_id": 5})],
+            "poison_quarantine", request_id=5,
+        )
+        torn = (base / "replica_0_flight" /
+                "flight_preemption_drain_20990101T000000_9999.json")
+        torn.write_text('{"flight_recorder_version": 1, "spans": [tru')
+
+        spec = importlib.util.spec_from_file_location(
+            "postmortem", os.path.join(_REPO, "scripts", "postmortem.py")
+        )
+        pm = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pm)
+        assert pm.select_dump(str(base), replica=0) == good
+        err = capsys.readouterr().err
+        assert "torn" in err
+        # A tree with ONLY torn dumps still fails loudly, naming why.
+        torn_only = tmp_path / "torn_only"
+        (torn_only / "replica_0_flight").mkdir(parents=True)
+        (torn_only / "replica_0_flight" /
+         "flight_x_20990101T000000_0001.json").write_text("{")
+        with pytest.raises(FileNotFoundError, match="torn"):
+            pm.select_dump(str(torn_only), replica=0)
 
 
 def _mesh_env():
@@ -1108,6 +1327,51 @@ class TestFleetBlastRadius:
         ))
         assert rid_inflight in dump["context"]["request_ids"]
         assert match_records(dump["spans"], request_id=rid_inflight)
+
+        # ---- cross-process trace adoption (the tentpole acceptance,
+        # pinned on this 4-process rig): stitch the run's exports —
+        # the router_drain dump (full ring + handshake clock offsets)
+        # against the replicas' own drain dumps — and require at least
+        # one request whose ONE trace_id spans ≥ 2 processes, with
+        # every per-hop delta non-negative under the clock handshake.
+        from raft_ncup_tpu.observability import (
+            collect_fleet_records,
+            fleet_traces,
+        )
+
+        collected = collect_fleet_records(cfg.base_dir)
+        assert "router" in collected["origins"]
+        assert collected["replicas"], collected
+        assert collected["clock_offsets"], (
+            "router_drain dump carried no handshake offsets"
+        )
+        # Same host, shared CLOCK_MONOTONIC: every offset is near zero.
+        assert all(
+            abs(o) < 0.5 for o in collected["clock_offsets"].values()
+        )
+        traces = fleet_traces(collected)
+        spanning = [t for t in traces if len(t["origins"]) >= 2]
+        assert spanning, (
+            f"no trace spans processes: "
+            f"{[(t['trace_id'], t['origins']) for t in traces][:10]}"
+        )
+        probe = spanning[0]
+        assert "router" in probe["origins"]
+        assert any(
+            o.startswith("replica_") for o in probe["origins"]
+        )
+        # One request -> exactly ONE trace.
+        assert probe["request_id"] is not None
+        assert len(fleet_traces(
+            collected, request_id=probe["request_id"]
+        )) == 1
+        # Per-hop deltas exist and are non-negative; the replica-side
+        # evidence (wire adoption span + queue wait) made it across.
+        assert probe["hops"], probe
+        assert all(v >= 0.0 for v in probe["hops"].values()), probe["hops"]
+        spanning_hops = set().union(*(t["hops"] for t in spanning))
+        assert {"wire_ms", "replica_queue_ms", "device_ms"} <= \
+            spanning_hops, spanning_hops
 
         # ---- bitwise blast radius: every surviving-replica response
         # equals an UNINJECTED run. The reference is a fresh

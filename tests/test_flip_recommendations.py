@@ -619,3 +619,33 @@ class TestFleetRow:
             **self._clean(),
         })
         assert any("fleet: steady state" in l for l in lines)
+
+    def test_fleet_telemetry_overhead_over_budget_is_flagged(self):
+        """The fleet telemetry on/off window rides the serve row's 3%
+        observer budget: over budget → flagged loudly; within → one
+        confirmation line; absent (BENCH_SKIP_TELEMETRY_COMPARE or an
+        older record) → silent."""
+        lines = flip._fleet_lines(self._clean(
+            fleet_telemetry_overhead_pct=4.7,
+            fleet_p50_ms_notelemetry=286.5,
+        ))
+        assert len(lines) == 2
+        assert "EXCEEDS the 3% budget" in lines[1]
+        assert "4.7%" in lines[1]
+
+    def test_fleet_telemetry_overhead_within_budget_confirms(self):
+        lines = flip._fleet_lines(self._clean(
+            fleet_telemetry_overhead_pct=0.9,
+            fleet_p50_ms_notelemetry=297.3,
+        ))
+        assert len(lines) == 2
+        assert "within the 3% budget" in lines[1]
+        # Negative delta (noise) is within budget too, not an error.
+        lines = flip._fleet_lines(self._clean(
+            fleet_telemetry_overhead_pct=-1.2,
+        ))
+        assert "within the 3% budget" in lines[1]
+
+    def test_fleet_telemetry_overhead_absent_is_silent(self):
+        lines = flip._fleet_lines(self._clean())
+        assert len(lines) == 1

@@ -527,3 +527,122 @@ class TestEvalLoopInvariants:
         np.testing.assert_allclose(
             out["synthetic"], host[0] / host[1], rtol=1e-4
         )
+
+
+# ----------------------------------------------------------- cost ledger
+
+
+class TestCostLedger:
+    """The executable cost ledger (inference/costs.py; docs/PERF.md):
+    XLA cost facts recorded once at compile time, keys stable across
+    re-warm, MFU non-null for any backend with a peak-FLOPs entry."""
+
+    def _fwd_with_ledger(self):
+        from raft_ncup_tpu.inference.costs import CostLedger
+
+        ledger = CostLedger(enabled=True)
+        fwd = ShapeCachedForward(
+            _DummyModel(), {}, cache_size=4, cost_ledger=ledger
+        )
+        return fwd, ledger
+
+    def test_records_costs_at_compile_time_only(self):
+        fwd, ledger = self._fwd_with_ledger()
+        img = np.zeros((1, 8, 10, 3), np.float32)
+        fwd.forward_device(img, img, 2)
+        assert fwd.stats["compiles"] == 1
+        assert len(ledger) == 1
+        entry = ledger.lookup(kind="forward", shape=(1, 8, 10, 3), iters=2)
+        assert entry is not None
+        assert entry["backend"] == jax.default_backend()
+        assert entry["compile_ms"] is not None and entry["compile_ms"] > 0
+        assert entry["flops"] is None or entry["flops"] >= 0
+        assert isinstance(entry["memory_stats"], dict)
+        # Warm calls touch the ledger no further (one entry, same key).
+        before = ledger.keys()
+        fwd.forward_device(img, img, 2)
+        assert fwd.stats["hits"] == 1
+        assert ledger.keys() == before
+
+    def test_key_stable_across_rewarm_zero_recompiles(self):
+        """The acceptance pin: same shape ⇒ same ledger key, and a
+        re-warm of the warm executable performs ZERO XLA compiles."""
+        from raft_ncup_tpu.analysis.guards import RecompileWatchdog
+
+        fwd, ledger = self._fwd_with_ledger()
+        img = np.zeros((1, 8, 10, 3), np.float32)
+        jax.block_until_ready(fwd.forward_device(img, img, 2))
+        keys_first = ledger.keys()
+        assert len(keys_first) == 1
+        with RecompileWatchdog() as wd:
+            jax.block_until_ready(fwd.forward_device(img, img, 2))
+        assert wd.count == 0
+        assert ledger.keys() == keys_first
+        # A fresh same-config cache writes the SAME ledger key (the key
+        # is the executable's identity, not the instance's).
+        fwd2 = ShapeCachedForward(
+            _DummyModel(), {}, cache_size=4, cost_ledger=ledger
+        )
+        jax.block_until_ready(fwd2.forward_device(img, img, 2))
+        assert ledger.keys() == keys_first
+
+    def test_distinct_shapes_distinct_keys(self):
+        fwd, ledger = self._fwd_with_ledger()
+        a = np.zeros((1, 8, 10, 3), np.float32)
+        b = np.zeros((1, 16, 10, 3), np.float32)
+        fwd.forward_device(a, a, 2)
+        fwd.forward_device(b, b, 2)
+        fwd.forward_device(a, a, 4)
+        assert len(ledger) == 3
+        metas = [
+            (e["meta"]["shape"], e["meta"]["iters"])
+            for e in (ledger.entry(k) for k in ledger.keys())
+        ]
+        assert len(set(map(str, metas))) == 3
+
+    def test_disabled_ledger_records_nothing(self):
+        from raft_ncup_tpu.inference.costs import CostLedger
+
+        ledger = CostLedger(enabled=False)
+        fwd = ShapeCachedForward(
+            _DummyModel(), {}, cache_size=4, cost_ledger=ledger
+        )
+        img = np.zeros((1, 8, 10, 3), np.float32)
+        fwd.forward_device(img, img, 2)
+        assert len(ledger) == 0
+        assert fwd.stats["compiles"] == 1  # cache accounting unchanged
+
+    def test_peak_table_and_mfu(self):
+        """MFU is non-null for every backend with a peak entry (CPU
+        included — a nominal per-core figure) and null ONLY for an
+        unknown backend, never for 'we did not measure'."""
+        from raft_ncup_tpu.inference import costs
+
+        assert costs.peak_flops("cpu") > 0
+        assert costs.peak_flops("tpu", tpu_gen="v5e") == 197e12
+        assert costs.peak_flops("tpu", device_kind="TPU v4") == 275e12
+        assert costs.peak_flops("tpu", device_kind="weird") is None
+        assert costs.peak_flops("quantum") is None
+        assert costs.peak_flops(None) is None
+        assert costs.mfu(1e9, 3.0, 48e9) == pytest.approx(0.0625)
+        assert costs.mfu(None, 3.0, 48e9) is None
+        assert costs.mfu(1e9, 3.0, None) is None
+        # Env override wins for CPU (autotuner/operator escape hatch).
+        import os as _os
+
+        _os.environ["RAFT_NCUP_CPU_PEAK_FLOPS"] = "1e12"
+        try:
+            assert costs.peak_flops("cpu") == 1e12
+        finally:
+            del _os.environ["RAFT_NCUP_CPU_PEAK_FLOPS"]
+
+    def test_snapshot_is_json_able(self):
+        import json as _json
+
+        fwd, ledger = self._fwd_with_ledger()
+        img = np.zeros((1, 8, 10, 3), np.float32)
+        fwd.forward_device(img, img, 2)
+        snap = _json.loads(_json.dumps(ledger.snapshot()))
+        assert snap["enabled"] is True
+        (entry,) = snap["entries"].values()
+        assert entry["meta"]["shape"] == [1, 8, 10, 3]

@@ -716,6 +716,82 @@ def test_jgl010_covers_the_consumer_half_modules(tmp_path, module):
     ) == []
 
 
+def test_jgl010_covers_aggregate_module(tmp_path):
+    """The fleet trace/registry aggregator (PR 14) is the offline tool
+    most tempted to import jax 'for convenience' — it sits under the
+    same host-only contract, pinned explicitly: a jax import or device
+    pull inside observability/aggregate.py is a finding; its real shape
+    (json merges, clock-offset arithmetic on host floats) is clean."""
+    dirty = """
+        import jax
+
+        def merge(records, value):
+            return records + [float(jax.device_get(value))]
+        """
+    findings = lint_snippet(
+        tmp_path, dirty, name="observability/aggregate.py",
+        select=["JGL010"],
+    )
+    assert [f.rule for f in findings] == ["JGL010"] * 2
+    clean = """
+        import json
+        import os
+
+        def translate(records, offset_s):
+            return [
+                {**r, "t": r["t_s"] - offset_s}
+                for r in records if "t_s" in r
+            ]
+
+        def read_tolerant(path):
+            out, skipped = [], 0
+            with open(path) as fh:
+                for line in fh:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        skipped += 1
+            return out, skipped
+        """
+    assert lint_snippet(
+        tmp_path, clean, name="observability/aggregate.py",
+        select=["JGL010"],
+    ) == []
+
+
+def test_jgl010_fleet_trace_header_must_stay_optional(tmp_path):
+    """Wire-compat contract: the frame schema's trace-context field is
+    OPTIONAL — a mandatory `header[\"trace\"]` READ in fleet/ would make
+    old peers' frames unparsable by new fleet code, so it is a finding;
+    reading with .get and WRITING the field (a producer knows its own
+    schema) are clean, as is the same subscript outside fleet/."""
+    dirty = """
+        def adopt(header):
+            ctx = header["trace"]  # mandatory read: old frames crash
+            return ctx
+        """
+    findings = lint_snippet(
+        tmp_path, dirty, name="fleet/router.py", select=["JGL010"],
+    )
+    assert [f.rule for f in findings] == ["JGL010"]
+    assert "optional" in findings[0].message.lower()
+    clean = """
+        def dispatch(header, ctx):
+            header["trace"] = ctx          # producer write: fine
+            return header.get("trace")     # tolerant read: fine
+        """
+    assert lint_snippet(
+        tmp_path, clean, name="fleet/router.py", select=["JGL010"],
+    ) == []
+    elsewhere = """
+        def adopt(header):
+            return header["trace"]  # not fleet/ wire code
+        """
+    assert lint_snippet(
+        tmp_path, elsewhere, name="serving/server.py", select=["JGL010"],
+    ) == []
+
+
 # ------------------------------------------------------------- allowlist
 
 

@@ -1440,3 +1440,283 @@ class TestSloEngineReviewRegressions:
         t["now"] = 3.0
         eng.evaluate()
         assert fresh.state == DEGRADED
+
+
+# ---------------------------------------------------------------- traces
+
+
+class TestTraceContext:
+    """Cross-process trace context (observability/spans.py): the
+    serializable (trace_id, parent span_id, clock offset) that rides the
+    fleet wire header as an OPTIONAL field."""
+
+    def test_wire_round_trip(self):
+        from raft_ncup_tpu.observability import TraceContext
+
+        ctx = TraceContext("abcd1234", "router-7", 0.125, 42.5)
+        wire = ctx.to_wire()
+        assert json.loads(json.dumps(wire)) == wire  # JSON-able
+        back = TraceContext.from_wire(wire)
+        assert back == ctx
+
+    def test_from_wire_tolerates_absent_and_garbage(self):
+        """Old peers send no context; corrupt headers send nonsense —
+        both parse to None, never an exception (the wire-compat
+        contract JGL010 pins statically)."""
+        from raft_ncup_tpu.observability import TraceContext
+
+        assert TraceContext.from_wire(None) is None
+        assert TraceContext.from_wire("not-a-dict") is None
+        assert TraceContext.from_wire({}) is None
+        assert TraceContext.from_wire({"trace_id": 7}) is None
+        assert TraceContext.from_wire(
+            {"trace_id": "x", "sent_s": "garbage"}
+        ) is None
+        # Minimal valid: just a trace id.
+        ctx = TraceContext.from_wire({"trace_id": "x"})
+        assert ctx is not None and ctx.trace_id == "x"
+        assert ctx.clock_offset_s == 0.0 and ctx.sent_s is None
+
+    def test_child_reparents_same_trace(self):
+        from raft_ncup_tpu.observability import TraceContext
+
+        ctx = TraceContext("t1", "root", 0.5, 1.0)
+        kid = ctx.child("replica-3", sent_s=2.0)
+        assert kid.trace_id == "t1"
+        assert kid.span_id == "replica-3"
+        assert kid.clock_offset_s == 0.5
+        assert kid.sent_s == 2.0
+
+    def test_trace_ids_are_unique(self):
+        from raft_ncup_tpu.observability import new_trace_id
+
+        ids = {new_trace_id() for _ in range(64)}
+        assert len(ids) == 64
+        assert all(len(i) == 16 for i in ids)
+
+
+class TestRecordTimestamps:
+    """Every ring record stamps ``t_s`` (its start on the tracer's
+    monotonic clock) — the absolute anchor aggregate.py orders
+    cross-process timelines by."""
+
+    def test_span_event_and_observe_carry_t_s(self):
+        t = {"now": 100.0}
+        tracer = SpanTracer(MetricsRegistry(), clock=lambda: t["now"])
+        with tracer.span("stage_a"):
+            t["now"] = 100.25
+        tracer.event("thing_happened")
+        t["now"] = 101.0
+        tracer.observe_ms("stage_b", 500.0)  # ended now, started -0.5s
+        recs = {r["name"]: r for r in tracer.records()}
+        assert recs["stage_a"]["t_s"] == 100.0
+        assert recs["stage_a"]["duration_ms"] == 250.0
+        assert recs["thing_happened"]["t_s"] == 100.25
+        assert recs["stage_b"]["t_s"] == pytest.approx(100.5)
+
+
+class TestAggregate:
+    """observability/aggregate.py: tolerant readers, the stitched fleet
+    trace tree with clock-offset translation, per-hop attribution, and
+    the merged registry view that marks dead replicas as gaps."""
+
+    @staticmethod
+    def _dump(path, spans, context=None):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump({
+                "flight_recorder_version": 1,
+                "trigger": "test",
+                "time_unix_s": 0.0,
+                "context": context or {},
+                "fingerprints": {},
+                "report": None,
+                "spans": spans,
+            }, fh)
+
+    def test_read_jsonl_tolerant_skips_truncated_tail(self, tmp_path):
+        """A replica killed mid-write leaves a partial last line: the
+        reader skips and COUNTS it instead of raising (the satellite
+        fix — a postmortem must survive the evidence of the fault)."""
+        from raft_ncup_tpu.observability import read_jsonl_tolerant
+
+        p = tmp_path / "replica_0_telemetry.jsonl"
+        with open(p, "w") as fh:
+            fh.write(json.dumps({"name": "telemetry_snapshot",
+                                 "report": {"metrics": {}}}) + "\n")
+            fh.write('{"name": "telemetry_snapshot", "repo')  # truncated
+        records, skipped = read_jsonl_tolerant(str(p))
+        assert len(records) == 1
+        assert skipped == 1
+        # Missing file: empty, not an exception.
+        assert read_jsonl_tolerant(str(tmp_path / "absent.jsonl")) == ([], 0)
+
+    def _fleet_tree(self, tmp_path, offset=5.0):
+        """A synthetic two-process export: the router's ring (root span
+        + dispatch event, offsets in the drain dump context) and replica
+        1's ring (wire hop + queue wait + dispatch + drain), with the
+        replica's clock ``offset`` seconds AHEAD of the router's."""
+        tid = "aaaa000011112222"
+        router = [
+            {"name": "fleet_dispatch", "event": True, "t_s": 10.001,
+             "attrs": {"request_id": 7, "replica": 1, "trace_id": tid}},
+            {"name": "fleet_request", "duration_ms": 250.0, "t_s": 10.0,
+             "attrs": {"request_id": 7, "replica": 1, "trace_id": tid}},
+        ]
+        replica = [
+            {"name": "fleet_wire_hop", "duration_ms": 2.0,
+             "t_s": 10.003 + offset,
+             "attrs": {"request_id": 7, "trace_id": tid,
+                       "parent_span_id": "router-7"}},
+            {"name": "serve_queue_wait", "duration_ms": 40.0,
+             "t_s": 10.003 + offset,
+             "attrs": {"request_id": 7, "batch_id": 0,
+                       "trace_id": tid}},
+            {"name": "serve_dispatch", "duration_ms": 5.0,
+             "t_s": 10.044 + offset,
+             "attrs": {"batch_id": 0, "request_ids": [7],
+                       "trace_ids": [tid], "iters": 2,
+                       "mesh": "nomesh", "policy": "f32"}},
+            {"name": "serve_drain", "duration_ms": 180.0,
+             "t_s": 10.049 + offset,
+             "attrs": {"batch_id": 0, "request_ids": [7],
+                       "trace_ids": [tid]}},
+        ]
+        self._dump(
+            str(tmp_path / "router_flight" /
+                "flight_router_drain_20260801T000000_0001.json"),
+            router,
+            context={"clock_offsets": {"1": offset}},
+        )
+        self._dump(
+            str(tmp_path / "replica_1_flight" /
+                "flight_preemption_drain_20260801T000000_0001.json"),
+            replica,
+        )
+        return tid
+
+    def test_trace_tree_spans_processes_with_nonnegative_hops(
+        self, tmp_path
+    ):
+        """One request → ONE trace_id across router and replica records,
+        replica timestamps translated through the handshake offset, and
+        every per-hop delta non-negative."""
+        from raft_ncup_tpu.observability import (
+            collect_fleet_records,
+            fleet_traces,
+            render_trace,
+        )
+
+        tid = self._fleet_tree(tmp_path, offset=5.0)
+        collected = collect_fleet_records(str(tmp_path))
+        assert collected["clock_offsets"] == {1: 5.0}
+        traces = fleet_traces(collected)
+        assert len(traces) == 1
+        tr = traces[0]
+        assert tr["trace_id"] == tid
+        assert tr["request_id"] == 7
+        assert tr["origins"] == ["replica_1", "router"]
+        assert tr["total_ms"] == 250.0
+        # Translated timeline is ordered: root first, drain last.
+        names = [r["name"] for r in tr["records"]]
+        assert names[0] == "fleet_request"
+        assert names.index("fleet_wire_hop") < names.index("serve_drain")
+        hops = tr["hops"]
+        for key in ("router_queue_ms", "wire_ms", "replica_queue_ms",
+                    "device_ms", "return_ms"):
+            assert key in hops, hops
+            assert hops[key] >= 0.0
+        assert hops["replica_queue_ms"] == 40.0
+        assert hops["device_ms"] == 180.0
+        assert hops["wire_ms"] == 2.0
+        # total = hops + residual, exactly.
+        assert sum(hops.values()) == pytest.approx(250.0)
+        # Renderable without error, mentions both origins.
+        text = "\n".join(render_trace(tr))
+        assert "router" in text and "replica_1" in text
+
+    def test_request_id_filter_and_skewed_offset_clamps(self, tmp_path):
+        """A wrong offset estimate must clamp hops at zero, never go
+        negative; the request_id filter narrows to one journey."""
+        from raft_ncup_tpu.observability import (
+            collect_fleet_records,
+            fleet_traces,
+        )
+
+        self._fleet_tree(tmp_path, offset=5.0)
+        collected = collect_fleet_records(str(tmp_path))
+        # Sabotage the offset by a full second: the translated replica
+        # records now precede the router's dispatch.
+        collected["clock_offsets"][1] = 6.0
+        traces = fleet_traces(collected, request_id=7)
+        assert len(traces) == 1
+        assert all(v >= 0.0 for v in traces[0]["hops"].values())
+        assert fleet_traces(collected, request_id=999) == []
+
+    def test_aggregate_registry_marks_dead_replica_gap(self, tmp_path):
+        """The merged registry view SUMS counters and MAXES gauges over
+        the replicas that exported, and NAMES the one that did not
+        (dead replica ⇒ gap) instead of silently shrinking the fleet."""
+        from raft_ncup_tpu.observability import aggregate_registry
+
+        def snap(path, completed, depth):
+            with open(path, "w") as fh:
+                fh.write(json.dumps({
+                    "name": "telemetry_snapshot",
+                    "time_unix_s": 0.0,
+                    "report": {"metrics": {
+                        "counters": {"serve_completed_total": completed},
+                        "gauges": {"serve_queue_depth":
+                                   {"value": depth, "peak": depth + 1}},
+                    }},
+                }) + "\n")
+
+        snap(tmp_path / "replica_0_telemetry.jsonl", 10, 2)
+        snap(tmp_path / "replica_2_telemetry.jsonl", 32, 5)
+        # Replica 1 existed (its socket path names it) but died without
+        # an export.
+        (tmp_path / "replica_1.sock").write_text("")
+        agg = aggregate_registry(str(tmp_path))
+        assert agg["counters"]["serve_completed_total"] == 42
+        assert agg["gauges"]["serve_queue_depth"]["value"] == 5
+        assert agg["gauges"]["serve_queue_depth"]["peak"] == 6
+        assert agg["replicas"] == [0, 2]
+        assert agg["gaps"] == [1]
+
+    def test_aggregate_registry_tolerates_truncated_jsonl(self, tmp_path):
+        from raft_ncup_tpu.observability import aggregate_registry
+
+        p = tmp_path / "replica_0_telemetry.jsonl"
+        with open(p, "w") as fh:
+            fh.write(json.dumps({
+                "name": "telemetry_snapshot",
+                "report": {"metrics": {"counters": {"x_total": 3}}},
+            }) + "\n")
+            fh.write('{"name": "telemetry_snapsho')  # killed mid-write
+        agg = aggregate_registry(str(tmp_path))
+        assert agg["counters"] == {"x_total": 3}
+        assert agg["skipped_lines"] == 1
+        assert agg["gaps"] == []
+
+    def test_collect_skips_torn_dump_falls_back_to_older(self, tmp_path):
+        """The newest dump of a process may be torn (killed mid-write
+        pre-os.replace never happens, but copies/foreign files do):
+        collection walks back to the newest PARSABLE one and counts the
+        skip."""
+        from raft_ncup_tpu.observability import collect_fleet_records
+
+        good = [{"name": "fleet_request", "duration_ms": 1.0,
+                 "t_s": 0.0, "attrs": {"trace_id": "t", "request_id": 1}}]
+        self._dump(
+            str(tmp_path / "router_flight" /
+                "flight_router_drain_20260801T000000_0001.json"),
+            good,
+        )
+        torn = (tmp_path / "router_flight" /
+                "flight_router_drain_20260801T000001_0002.json")
+        torn.write_text('{"flight_recorder_version": 1, "spa')
+        collected = collect_fleet_records(str(tmp_path))
+        assert collected["skipped_dumps"] == 1
+        assert [r["name"] for r in collected["origins"]["router"]] == [
+            "fleet_request"
+        ]
