@@ -286,13 +286,17 @@ def _child_main() -> None:
             )
     if corr_impl == "pallas":
         ccounts = corr_pallas_mod.dispatch_counts()
-        # Partial per-level fallback (1080p level 0) is by design; only
-        # zero kernel levels makes the label a lie.
-        corr_ok = ccounts["kernel"] > 0
+        # Partial per-level fallback is by design; a level on the BANDED
+        # tier is still the fused kernel (three-tier dispatch,
+        # ops/corr_pallas.py) — only zero kernel-tier levels makes the
+        # label a lie.
+        on_kernel = ccounts["kernel"] + ccounts["banded"]
+        corr_ok = on_kernel > 0
         record["fused_ok"] = bool(record.get("fused_ok", True) and corr_ok)
         record["corr_pallas_levels"] = (
-            f"{ccounts['kernel']}/{ccounts['levels_total']}"
+            f"{on_kernel}/{ccounts['levels_total']}"
         )
+        record["corr_pallas_banded_levels"] = ccounts["banded"]
         if not corr_ok:
             print(
                 f"corr=pallas dispatch counts {ccounts}: no level ran the "
@@ -560,6 +564,20 @@ def _child_main() -> None:
                 _emit(record)
             except Exception as e:  # never lose the earlier rows
                 print(f"bf16 highres bench failed: {e}", file=sys.stderr)
+
+    # UHD/4K row (docs/PERF.md "Banded dispatch"; ROADMAP item 4's
+    # second half): the 2176x3840 single-frame forward the banded corr
+    # tier makes servable, guarded like the highres row. Very last in
+    # budget order — a 4K compile must never starve anything else;
+    # BENCH_SKIP_UHD=1 turns it off, BENCH_UHD_* tune shape/iters/reps.
+    if os.environ.get("BENCH_SKIP_UHD") == "1":
+        pass
+    elif child_budget - (time.monotonic() - t0) > 0.12 * child_budget:
+        try:
+            record.update(_measure_uhd(variables))
+            _emit(record)
+        except Exception as e:  # never lose the earlier rows
+            print(f"uhd bench failed: {e}", file=sys.stderr)
 
 
 def _measure_bf16_forward(
@@ -1787,6 +1805,119 @@ def _measure_highres(variables: dict, precision: str = "f32") -> dict:
         row["highres_analysis_temp_gib_unsharded"] = ref["temp_gib"]
         row["highres_recompiles"] += ref["recompiles"]
         row["highres_host_transfers"] += ref["host_transfers"]
+    return row
+
+
+def _measure_uhd(variables: dict, precision: str = "f32") -> dict:
+    """Guarded UHD (4K) throughput row: the flagship test-mode forward
+    at 2176x3840 — the shape the banded Pallas corr tier
+    (ops/corr_pallas.py; docs/PERF.md "Banded dispatch") broke the
+    correlation memory wall for.
+
+    Honest per platform: on a TPU-class backend the row runs
+    ``corr_impl='pallas'`` (resident + banded kernel tiers; the
+    trace-time tier tally lands in ``uhd_corr_dispatch``) at the Sintel
+    eval iteration count; on CPU it runs the XLA onthefly fallback at
+    reduced iters (``BENCH_UHD_ITERS``, default 1 — a 4K interpret-mode
+    kernel window is not a measurement) and the row says so
+    (``uhd_corr_impl``/``uhd_platform``) so ``flip_recommendations``
+    stages it rather than judging it. Overrides: ``BENCH_UHD_SIZE``
+    ("H,W"), ``BENCH_UHD_CORR``, ``BENCH_UHD_REPS``.
+
+    The correlation tuning knobs behind the window — onthefly
+    ``row_chunk`` (``RAFT_NCUP_CORR_ROW_CHUNK``), Pallas query block /
+    band rows — are recorded (``uhd_corr_row_chunk`` /
+    ``uhd_corr_query_block`` / ``uhd_corr_band_rows``), the same values
+    the cost ledger stamps into the executable's meta.
+
+    Guards: timed reps under ``RecompileWatchdog`` +
+    ``forbid_host_transfers`` — ``uhd_recompiles`` /
+    ``uhd_host_transfers`` must be 0 (per-rep sync is one sanctioned
+    scalar ``jax.device_get``).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_ncup_tpu.analysis.guards import (
+        GuardStats,
+        RecompileWatchdog,
+        forbid_host_transfers,
+    )
+    from raft_ncup_tpu.config import flagship_config
+    from raft_ncup_tpu.models.raft import get_model
+    from raft_ncup_tpu.ops import corr_pallas as cpk
+    from raft_ncup_tpu.ops.corr import corr_tuning_meta
+    from raft_ncup_tpu.parallel.step import make_eval_step
+
+    platform = jax.devices()[0].platform
+    on_accel = platform != "cpu"
+    H, W = (
+        int(x)
+        for x in os.environ.get("BENCH_UHD_SIZE", "2176,3840").split(",")
+    )
+    iters = int(
+        os.environ.get("BENCH_UHD_ITERS", "32" if on_accel else "1")
+    )
+    reps = int(os.environ.get("BENCH_UHD_REPS", "3" if on_accel else "2"))
+    corr_impl = os.environ.get(
+        "BENCH_UHD_CORR", "pallas" if on_accel else "onthefly"
+    )
+    strict = os.environ.get("BENCH_STRICT_GUARDS") == "1"
+
+    model = get_model(
+        flagship_config(
+            dataset="sintel", corr_impl=corr_impl, precision=precision
+        )
+    )
+    step = make_eval_step(model, iters=iters, mesh=None)
+    img = jax.ShapeDtypeStruct((1, H, W, 3), jnp.float32)
+    cpk.reset_dispatch_counts()
+    t0 = time.perf_counter()
+    compiled = step.lower(variables, img, img).compile()
+    compile_s = time.perf_counter() - t0
+    dispatch = cpk.dispatch_counts() if corr_impl == "pallas" else None
+    mem = compiled.memory_analysis()
+
+    rng = np.random.default_rng(11)
+    img1 = jnp.asarray(rng.uniform(0, 255, (1, H, W, 3)), jnp.float32)
+    img2 = jnp.asarray(rng.uniform(0, 255, (1, H, W, 3)), jnp.float32)
+    # Warm rep outside the guards: also compiles the tiny scalar-slice
+    # sync program so the timed window sees zero compiles.
+    out = compiled(variables, img1, img2)
+    jax.device_get(out[1][0, 0, 0, 0])
+    stats = GuardStats()
+    rep_s = []
+    with RecompileWatchdog() as wd, forbid_host_transfers(
+        stats, raise_on_violation=strict
+    ):
+        for _ in range(max(1, reps)):
+            t0 = time.perf_counter()
+            out = compiled(variables, img1, img2)
+            jax.device_get(out[1][0, 0, 0, 0])
+            rep_s.append(time.perf_counter() - t0)
+    rep_s.sort()
+    median = rep_s[len(rep_s) // 2]
+    tuning = corr_tuning_meta()
+    row = {
+        "uhd_pairs_per_sec": round(1.0 / median, 4) if median else 0.0,
+        "uhd_rep_ms": [round(t * 1e3, 1) for t in rep_s],
+        "uhd_shape": f"1x{H}x{W}",
+        "uhd_iters": iters,
+        "uhd_corr_impl": corr_impl,
+        "uhd_platform": platform,
+        "uhd_compile_s": round(compile_s, 1),
+        "uhd_analysis_temp_gib": round(
+            int(mem.temp_size_in_bytes) / 2**30, 3
+        ),
+        "uhd_corr_row_chunk": tuning["corr_row_chunk"],
+        "uhd_corr_query_block": tuning.get("corr_query_block"),
+        "uhd_corr_band_rows": tuning.get("corr_band_rows"),
+        "uhd_recompiles": wd.count,
+        "uhd_host_transfers": stats.host_transfers,
+    }
+    if dispatch is not None:
+        row["uhd_corr_dispatch"] = dispatch
     return row
 
 

@@ -336,9 +336,14 @@ class ServeConfig:
     # AsyncDrain queue depth (bounds device memory pinned by pulls).
     drain_depth: int = 2
     # Admission shape limits: smaller than min breaks the feature
-    # pyramid; larger than max is rejected rather than compiled.
+    # pyramid; larger than max is rejected rather than compiled. The
+    # ceiling is UHD (2176x3840 = 4K padded to /8): the banded Pallas
+    # corr tier (ops/corr_pallas.py) keeps every pyramid level on a
+    # kernel tier at that shape, and the onthefly fallback bounds the
+    # working set, so a 4K request is servable rather than a
+    # memory-wall crash (docs/PERF.md "Banded dispatch").
     min_image_hw: int = 16
-    max_image_hw: tuple[int, int] = (1088, 1920)
+    max_image_hw: tuple[int, int] = (2176, 3840)
     # Per-ServeConfig precision policy (docs/PRECISION.md): the server's
     # whole executable set compiles under this preset, and the policy
     # name is part of every compiled-program key, so two servers (or one
@@ -399,7 +404,10 @@ class StreamConfig:
     capacity: int = 8
     # Native frame size the engine serves (frames whose PADDED shape
     # matches are also admitted — pad bucketing collapses near-identical
-    # camera resolutions onto one slot-table shape).
+    # camera resolutions onto one slot-table shape). Any /8-padded shape
+    # up to UHD (2176, 3840) is warmable: the banded corr tier keeps 4K
+    # per-level lookups on-kernel (ops/corr_pallas.py; docs/PERF.md
+    # "Banded dispatch").
     frame_hw: tuple[int, int] = (96, 128)
     pad_bucket: int = 0  # same semantics as ServeConfig.pad_bucket
     iters: int = 12  # fixed GRU iterations (one executable per batch size)

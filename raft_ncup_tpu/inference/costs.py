@@ -27,6 +27,14 @@ padded shape, iters, precision fingerprint...) — so the ledger key is
 stable across re-warms by construction: same shape ⇒ same key, and a
 re-warm that hits the LRU records nothing twice.
 
+Forward/metric entries' ``meta`` additionally carries the correlation
+tuning knobs the executable was traced with
+(``ops.corr.corr_tuning_meta``: onthefly ``corr_row_chunk``, Pallas
+``corr_query_block`` / ``corr_band_rows``) — the first real knob
+surface for the ROADMAP item-1 autotuner, persisted right next to the
+cost facts a sweep would optimize, under the same stable keys its
+tuning cache will use.
+
 **Why this lives here and not in observability/**: reading XLA cost
 analysis requires jax, and ``observability/`` is host-only stdlib by
 lint rule JGL010 — telemetry must never be able to initialize a

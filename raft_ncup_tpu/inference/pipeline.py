@@ -480,6 +480,15 @@ class ShapeCachedForward:
         ledger, backend = self.costs, self._backend
         ledger_key = f"{backend}|{full_key}"
         meta = self._ledger_meta(raw_key)
+        if meta.get("kind") in ("forward", "metrics"):
+            # The correlation tuning knobs the executable was traced
+            # with (onthefly row_chunk, Pallas query block / band rows
+            # — ops/corr.corr_tuning_meta): the first real sweep
+            # surface for ROADMAP item 1's autotuner, persisted next
+            # to the XLA cost facts it will optimize against.
+            from raft_ncup_tpu.ops.corr import corr_tuning_meta
+
+            meta.update(corr_tuning_meta())
         box: dict = {}
         lock = threading.Lock()
 

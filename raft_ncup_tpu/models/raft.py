@@ -324,10 +324,14 @@ class RAFT:
                     "corr_impl='pallas' requires raft_ncup_tpu.ops.corr_pallas"
                 ) from e
 
-            # Dispatch is per pyramid level inside the op: levels whose
-            # padded slab fits the VMEM budget take the kernel, the rest
-            # (at 1080p: levels 0-1) take the XLA on-the-fly path. Shapes are
-            # static at trace time, so this is a compile-time choice.
+            # Dispatch is per pyramid level inside the op, THREE tiers:
+            # levels whose padded slab fits the VMEM budget take the
+            # resident kernel, levels past residency with a fitting
+            # band_plan take the BANDED kernel (at 1080p f32: levels
+            # 0-1 banded, 2-3 resident; at 4K every level lands on a
+            # kernel tier), and only the remainder takes the XLA
+            # on-the-fly path. Shapes are static at trace time, so this
+            # is a compile-time choice.
             # Mosaic lowers only on TPU-class backends; on non-TPU
             # platforms the kernel runs in interpret mode (slow but
             # correct) so corr_impl='pallas' works everywhere.

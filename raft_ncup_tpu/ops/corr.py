@@ -28,12 +28,60 @@ A fused Pallas kernel for the lookup lives in
 from __future__ import annotations
 
 import math
+import os
 from typing import NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp
 
 from raft_ncup_tpu.ops.geometry import avg_pool2, grid_sample
+
+ROW_CHUNK_ENV = "RAFT_NCUP_CORR_ROW_CHUNK"
+_DEFAULT_ROW_CHUNK = 8
+
+
+def _env_int(name: str) -> int | None:
+    """Positive-int env knob parse, shared by every correlation tuning
+    knob (this module's row_chunk; corr_pallas's query_block /
+    band_rows): unset, non-int, or non-positive all mean "no
+    override"."""
+    raw = os.environ.get(name)
+    if not raw:
+        return None
+    try:
+        v = int(raw)
+    except ValueError:
+        return None
+    return v if v > 0 else None
+
+
+def effective_row_chunk() -> int:
+    """The row-chunk size ``corr_lookup_onthefly`` traces with when the
+    caller passes none: the ``RAFT_NCUP_CORR_ROW_CHUNK`` override if
+    set (a tuning knob — larger chunks amortize the scan at more peak
+    memory; the 4K fallback/sharded paths are where it matters), else
+    8. Recorded in the cost-ledger meta (:func:`corr_tuning_meta`) so
+    the choice behind a warmed executable is visible to
+    ``scripts/flip_recommendations.py`` and ROADMAP item 1's
+    autotuner."""
+    return _env_int(ROW_CHUNK_ENV) or _DEFAULT_ROW_CHUNK
+
+
+def corr_tuning_meta() -> dict:
+    """Effective correlation tuning-knob values — one flat dict the
+    compiled-executable cost ledger (inference/costs.py) stamps into
+    every forward/metric entry's meta: the onthefly ``row_chunk`` plus
+    the Pallas kernel's query-block / band-rows knobs
+    (``ops.corr_pallas.tuning_meta``). The autotuner's sweep surface:
+    persisted next to the XLA cost facts, keyed like the executables."""
+    meta = {"corr_row_chunk": effective_row_chunk()}
+    try:
+        from raft_ncup_tpu.ops import corr_pallas
+
+        meta.update(corr_pallas.tuning_meta())
+    except ImportError:  # pragma: no cover - jax builds without pallas
+        pass
+    return meta
 
 
 class CorrPyramid(NamedTuple):
@@ -146,7 +194,7 @@ def corr_lookup_onthefly(
     coords: jax.Array,
     radius: int,
     num_levels: int = 4,
-    row_chunk: int = 8,
+    row_chunk: int | None = None,
     levels: Sequence[int] | None = None,
     dtype=None,
 ) -> jax.Array:
@@ -160,7 +208,10 @@ def corr_lookup_onthefly(
       fmap1, fmap2: (B, H, W, C).
       coords: (B, H, W, 2).
       row_chunk: query rows processed per scan step (H % row_chunk may be
-        nonzero; handled by padding).
+        nonzero; handled by padding). ``None`` (default) resolves via
+        :func:`effective_row_chunk` — 8, overridable with
+        ``RAFT_NCUP_CORR_ROW_CHUNK`` (the knob that tunes the 4K
+        fallback path; its value rides the cost-ledger meta).
       levels: pyramid level indices to compute (default: all
         ``num_levels``); the Pallas dispatcher uses this to source only
         the levels whose slab exceeds its VMEM budget.
@@ -173,6 +224,8 @@ def corr_lookup_onthefly(
     K = 2 * radius + 1
     scale = 1.0 / math.sqrt(C)
     dtype = dtype or jnp.float32
+    if row_chunk is None:
+        row_chunk = effective_row_chunk()
     level_ids = tuple(range(num_levels)) if levels is None else tuple(levels)
     f2_levels = _pool_fmap_pyramid(fmap2.astype(dtype), num_levels)
     f1 = fmap1.astype(dtype)

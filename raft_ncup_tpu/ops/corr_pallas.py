@@ -32,15 +32,54 @@ Zero-padding semantics (out-of-bounds taps contribute zero, matching
 side; window starts are clamped into the padded array, and any fully-OOB
 window lands entirely inside the zero margin.
 
-VMEM budget: the padded level must stay resident on-chip next to the
-pipeline's block buffers. The budget is derived from the per-core VMEM
-capacity (~16 MiB on current TPUs — /opt/skills/guides/pallas_guide.md
-"Memory Hierarchy"; override with RAFT_NCUP_VMEM_BYTES) minus the blocked
-operands' double buffers. Dispatch is PER LEVEL: at 1080p levels 0-1
-(~42 MB and ~15.3 MB padded, both over the 0.9x budget) fall back to
-the XLA on-the-fly path while levels 2-3 still take the kernel
-(round-2 gated all-or-nothing on level 0 — VERDICT.md weak #4; exact
-counts pinned by tests/test_pallas_lowering.py).
+VMEM budget: the RESIDENT kernel keeps the whole padded level on-chip
+next to the pipeline's block buffers. The budget is derived from the
+per-core VMEM capacity (~16 MiB on current TPUs —
+/opt/skills/guides/pallas_guide.md "Memory Hierarchy"; override with
+RAFT_NCUP_VMEM_BYTES) minus the blocked operands' double buffers.
+
+Banded tier (round-15 redesign — the correlation memory wall,
+ROADMAP item 4): levels whose padded slab exceeds the resident budget
+no longer fall straight back to XLA. The level is split into horizontal
+BANDS of ``band_rows`` origin rows; each program touches only its
+band's slab plus a ``K+2``-row halo, sized by :func:`band_plan` so
+``band_slab + query blocks + scratch`` fits the same ``fits_vmem``
+budget at the policy itemsize. Mechanics:
+
+- The zero-padded level stays in HBM (``memory_space=ANY``); one band
+  slab of ``band_rows + K + 2`` rows is DMA'd into a single VMEM
+  scratch (``pltpu.make_async_copy``) when the band changes — the slab
+  is NOT double-buffered, which is exactly what lets a 4K level-0 band
+  fit where a blocked operand's double buffer would not.
+- Queries are assigned XLA-side to the band containing their clamped
+  window origin (``ibase`` already computes it), stable-argsorted by
+  band, and a per-(batch) chunk table — the (band, query-block,
+  lo, hi, fresh-band) segments of the sorted query array, i.e. the
+  ``(B, band, query_block)`` grid with its empty cells compressed out —
+  ships as a scalar-prefetch operand in SMEM
+  (``pltpu.PrefetchScalarGridSpec``) and drives every block index map.
+- The kernel grid is ``(B, chunk)`` with a MASKED group loop: groups
+  outside the chunk's ``[lo, hi)`` sorted-query range are skipped, and
+  boundary groups accumulate masked contributions, so a query block
+  straddling a band boundary is completed by its neighbouring chunks
+  (consecutive out-block revisits — the sanctioned accumulation
+  pattern). Out-of-band taps read the band's own zero/halo rows, so
+  zero-padding semantics stay BITWISE identical to the resident kernel.
+
+Dispatch is PER LEVEL and THREE-TIER: resident kernel -> banded kernel
+-> XLA onthefly (counted separately in ``dispatch_counts``). At 1080p
+f32, levels 0-1 (~42 MB / ~15.3 MB padded, both over the 0.9x resident
+budget) now take the BANDED kernel and levels 2-3 the resident one; at
+4K (2176x3840) every level qualifies for a kernel tier at f32 and bf16
+(exact counts pinned by tests/test_pallas_lowering.py). The XLA
+fallback remains only for jax builds without pallas-tpu and for band
+overrides that reject.
+
+Tuning knobs (the first real surface for ROADMAP item 1's autotuner;
+recorded in the cost-ledger meta via ``ops.corr.corr_tuning_meta``):
+``RAFT_NCUP_CORR_QUERY_BLOCK`` (queries per block, default 512) and
+``RAFT_NCUP_CORR_BAND_ROWS`` (band origin rows; default: largest that
+fits the budget, multiple-of-8 preferred).
 
 The kernel is forward-only; ``corr_lookup_pallas`` wraps it in a
 ``jax.custom_vjp`` whose backward runs the XLA on-the-fly path's VJP, so
@@ -51,6 +90,7 @@ from __future__ import annotations
 
 import functools
 import math
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -64,32 +104,81 @@ except ImportError:  # pragma: no cover - CPU-only jax builds
     pltpu = None
     _SMEM = None
 
+from raft_ncup_tpu.ops.corr import _env_int
 from raft_ncup_tpu.utils.runtime import VMEM_BYTES as _VMEM_BYTES
 
 _QUERY_BLOCK = 512
 _GROUP = 8  # queries per vectorized inner step (sublane tile)
 
+QUERY_BLOCK_ENV = "RAFT_NCUP_CORR_QUERY_BLOCK"
+BAND_ROWS_ENV = "RAFT_NCUP_CORR_BAND_ROWS"
+
+
+def effective_query_block() -> int:
+    """The query-block size both kernel tiers trace with: the
+    ``RAFT_NCUP_CORR_QUERY_BLOCK`` override when set, else 512. A
+    tuning knob (ROADMAP item 1): smaller blocks shrink the
+    double-buffered block term of the VMEM budget, buying band rows."""
+    return _env_int(QUERY_BLOCK_ENV) or _QUERY_BLOCK
+
+
+def band_rows_override() -> int | None:
+    """``RAFT_NCUP_CORR_BAND_ROWS`` when set (an expert/autotuner knob:
+    it wins over :func:`band_plan`'s budget-derived choice), else None
+    = auto."""
+    return _env_int(BAND_ROWS_ENV)
+
+
+def tuning_meta() -> dict:
+    """The kernel's effective tuning-knob values, as recorded into the
+    cost-ledger entry meta of every compiled executable
+    (inference/costs.py) — the surface ROADMAP item 1's autotuner
+    sweeps."""
+    return {
+        "corr_query_block": effective_query_block(),
+        "corr_band_rows": band_rows_override() or "auto",
+    }
+
+
 # Trace-time per-level dispatch tally, mirroring ops.nconv: callers that
-# label a measurement "corr=pallas" (bench.py) use this to tell whether
-# the kernel took any level at all or everything fell back to XLA
-# onthefly (partial fallback — e.g. 1080p levels 0-1 — is by design and
-# still counts as the kernel running).
-_dispatch_counts = {"kernel": 0, "fallback": 0, "levels_total": 0}
+# label a measurement "corr=pallas" (bench.py) use this to tell which
+# tier carried each pyramid level — resident kernel, banded kernel, or
+# the XLA onthefly fallback (partial mixes are by design at large
+# shapes and still count as the kernel running). Guarded by a lock:
+# concurrent traces (two warmups on different threads) must not lose
+# increments, even though a mixed tally is only interpretable under the
+# single-thread discipline documented on dispatch_counts().
+_counts_lock = threading.Lock()
+_dispatch_counts = {
+    "kernel": 0, "banded": 0, "fallback": 0, "levels_total": 0,
+}
 
 
 def reset_dispatch_counts() -> None:
-    for k in _dispatch_counts:
-        _dispatch_counts[k] = 0
+    with _counts_lock:
+        for k in _dispatch_counts:
+            _dispatch_counts[k] = 0
 
 
 def dispatch_counts() -> dict:
-    """Copy of the per-level dispatch tally since the last reset (counts
-    trace-time decisions, one per pyramid level per TRACE — a custom_vjp
-    backward trace, a shape-driven retrace, or a concurrent thread each
-    add their own tallies, so the counts are only interpretable between
-    a reset and a single lowering in a single thread, the discipline
-    bench.py follows)."""
-    return dict(_dispatch_counts)
+    """Copy of the per-level dispatch tally since the last reset.
+
+    Three tier keys plus the denominator: ``kernel`` (whole level
+    VMEM-resident), ``banded`` (level banded + DMA'd per band, see
+    module docstring), ``fallback`` (XLA onthefly), and
+    ``levels_total``. Counts trace-time decisions, one per pyramid
+    level per TRACE — a custom_vjp backward trace, a shape-driven
+    retrace, or a concurrent thread each add their own tallies, so the
+    counts are only interpretable between a reset and a single lowering
+    in a single thread, the discipline bench.py follows (mutation
+    itself is lock-guarded, so concurrent traces can't lose counts)."""
+    with _counts_lock:
+        return dict(_dispatch_counts)
+
+
+def _count(tier: str, n: int = 1) -> None:
+    with _counts_lock:
+        _dispatch_counts[tier] += n
 
 
 def _padded_hw(h: int, w: int, radius: int) -> tuple[int, int, int]:
@@ -104,7 +193,7 @@ def _level_vmem_bytes(
     w: int,
     channels: int,
     radius: int,
-    query_block: int = _QUERY_BLOCK,
+    query_block: int | None = None,
     itemsize: int = 4,
 ) -> int:
     """Bytes of VMEM the kernel needs for one (h, w) level: the resident
@@ -114,6 +203,8 @@ def _level_vmem_bytes(
     dispatch-threshold doubling ROADMAP item 3 wanted; the frac/out
     blocks stay f32 but are a few percent of the slab, so budgeting them
     at ``itemsize`` keeps the threshold ratio an exact itemsize ratio)."""
+    if query_block is None:
+        query_block = effective_query_block()
     hp, wp, _ = _padded_hw(h, w, radius)
     K1 = 2 * radius + 2
     slab = hp * wp * channels
@@ -135,6 +226,100 @@ def fits_vmem(
     return _level_vmem_bytes(
         h, w, channels, radius, itemsize=itemsize
     ) <= int(0.9 * _VMEM_BYTES)
+
+
+def _band_geometry(
+    hp: int, radius: int, band_rows: int
+) -> tuple[int, int]:
+    """(origin_rows, n_bands) for a padded level of height ``hp``: the
+    ONE derivation of the band count, shared by :func:`band_plan` and
+    the kernel-side geometry in :func:`_banded_lookup_one_level` so the
+    planned count and the DMA/chunk-table layout can never drift.
+    Clamped window origins span [0, hp - (K+1)] (the ``lim`` clip), so
+    ``origin_rows = hp - K`` rows need band coverage."""
+    origin_rows = hp - (2 * radius + 1)
+    return origin_rows, max(1, -(-origin_rows // band_rows))
+
+
+def _band_halo(radius: int) -> int:
+    # Rows a band's slab extends past its last origin row: a window
+    # origin on the band's final row reads K+1 rows, so K+1 is the hard
+    # floor; K+2 keeps one spare row of the zero margin in-slab so a
+    # clamped fully-OOB window stays entirely inside zeros even at the
+    # band seam (mirrors the K+2 pad of _padded_hw).
+    return 2 * radius + 3
+
+
+def _banded_vmem_bytes(
+    h: int,
+    w: int,
+    channels: int,
+    radius: int,
+    band_rows: int,
+    query_block: int | None = None,
+    itemsize: int = 4,
+) -> int:
+    """Bytes of VMEM the BANDED kernel needs for one (h, w) level at
+    ``band_rows`` origin rows per band: the single-buffered band slab
+    (``band_rows + K + 2`` padded rows — the level itself stays in HBM
+    and the slab is DMA'd, so no pipeline double buffer) + the same
+    double-buffered query blocks and group scratch as the resident
+    kernel, all at ``itemsize`` (the policy's corr dtype — bf16 halves
+    every term, exactly the threshold doubling the resident tier
+    already has; tests/test_precision.py pins the ratio for this budget
+    too)."""
+    if query_block is None:
+        query_block = effective_query_block()
+    _, wp, _ = _padded_hw(h, w, radius)
+    K1 = 2 * radius + 2
+    slab = (band_rows + _band_halo(radius)) * wp * channels
+    blocks = 2 * query_block * (channels + 2 + (K1 - 1) ** 2)
+    scratch = _GROUP * K1 * K1 * channels
+    return itemsize * (slab + blocks + scratch)
+
+
+def band_plan(
+    h: int,
+    w: int,
+    channels: int,
+    radius: int = 4,
+    dtype=None,
+    query_block: int | None = None,
+) -> tuple[int, int] | None:
+    """Band geometry for a level too large for the resident kernel:
+    ``(band_rows, n_bands)``, or ``None`` when not even a 1-row band
+    fits the budget (the level then falls back to XLA onthefly).
+
+    ``band_rows`` is the largest count whose banded budget
+    (:func:`_banded_vmem_bytes`) fits 0.9x VMEM at ``dtype``'s element
+    size, rounded down to a multiple of 8 when >= 8 (sublane-friendly
+    DMA rows); ``RAFT_NCUP_CORR_BAND_ROWS`` overrides it unconditionally
+    (the autotuner's sweep knob — an expert override is trusted, the
+    budget check is for the AUTO choice). ``n_bands`` partitions the
+    clamped window-origin rows of the PADDED level."""
+    if query_block is None:
+        query_block = effective_query_block()
+    itemsize = 4 if dtype is None else int(jnp.dtype(dtype).itemsize)
+    hp, _, _ = _padded_hw(h, w, radius)
+    origin_rows, _ = _band_geometry(hp, radius, 1)
+    override = band_rows_override()
+    if override is not None:
+        band_rows = max(1, min(override, origin_rows))
+    else:
+        budget = int(0.9 * _VMEM_BYTES)
+        fixed = _banded_vmem_bytes(
+            h, w, channels, radius, 0, query_block, itemsize
+        )
+        if fixed > budget:
+            return None  # blocks+scratch+halo alone blow the budget
+        per_row = itemsize * (w + 2 * (2 * radius + 3)) * channels
+        band_rows = (budget - fixed) // per_row
+        if band_rows < 1:
+            return None
+        band_rows = int(min(band_rows, origin_rows))
+        if band_rows >= 8:
+            band_rows -= band_rows % 8
+    return band_rows, _band_geometry(hp, radius, band_rows)[1]
 
 
 def _lookup_kernel(
@@ -257,6 +442,256 @@ def _lookup_one_level(
     return out[:, :N].transpose(0, 1, 3, 2).reshape(B, N, K * K)
 
 
+def _banded_lookup_kernel(
+    tbl_ref, ibase_ref, f1_ref, frac_ref, f2_ref, out_ref,
+    slab_ref, scratch_ref, sem, *, radius, qblk, band_rows,
+):
+    """One (batch, chunk) program of the banded tier.
+
+    tbl_ref:     (B, n_chunks, 5) int32, SMEM (scalar prefetch) — per
+                 chunk: band id, query-block id, [lo, hi) sorted-query
+                 range, fresh-band flag (1 = DMA a new band slab).
+    ibase_ref:   (Q, 2) int32, SMEM — clamped window origins per SORTED
+                 query: (x in the padded level, y LOCAL to the band).
+    f1_ref:      (Q, C) compute dtype — sorted query features.
+    frac_ref:    (Q, 2) float32 — sorted sub-pixel offsets (fx, fy).
+    f2_ref:      (B, Hb, Wp, C) compute dtype, HBM (memory_space=ANY) —
+                 the whole zero-padded level; never resident.
+    out_ref:     (Q, K, K) float32 — window values in SORTED query
+                 order, natural (y, x); revisited consecutively by the
+                 chunks of one query block (accumulation pattern).
+    slab_ref:    (band_rows + K + 2, Wp, C) VMEM scratch — the band
+                 slab, DMA'd from HBM on a fresh-band chunk. Single
+                 buffered: this is what the banded budget counts.
+    scratch_ref: (G, K+1, K+1, C) VMEM scratch (as the resident kernel).
+    sem:         DMA completion semaphore.
+    """
+    K = 2 * radius + 1
+    K1 = K + 1
+    G = _GROUP
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    band = tbl_ref[b, j, 0]
+    lo = tbl_ref[b, j, 2]
+    hi = tbl_ref[b, j, 3]
+    base_q = tbl_ref[b, j, 1] * qblk
+
+    @pl.when(tbl_ref[b, j, 4] == 1)
+    def _copy_band():
+        # Synchronous band-slab DMA: consecutive chunks of one band skip
+        # it (fresh flag 0), so the level streams from HBM once per band
+        # plus halo overlap. No double buffer — the whole point of the
+        # banded budget (see _banded_vmem_bytes).
+        cp = pltpu.make_async_copy(
+            f2_ref.at[b, pl.ds(band * band_rows, slab_ref.shape[0])],
+            slab_ref,
+            sem,
+        )
+        cp.start()
+        cp.wait()
+
+    @pl.when(lo == base_q)
+    def _init_block():
+        # First chunk of this query block zero-inits the out block; the
+        # block stays VMEM-resident across its (consecutive) chunks.
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    def body(i, _):
+        gbase = i * G
+        q0 = base_q + gbase
+
+        @pl.when((q0 + G > lo) & (q0 < hi))
+        def _group():
+            # Masked group: same vectorized math as the resident kernel,
+            # reading the band slab with band-local row origins; lanes
+            # outside [lo, hi) (a boundary group's neighbours from the
+            # adjacent band) are computed against this band's slab —
+            # memory-safe via the band-local clamp — and masked out of
+            # the accumulate, so the neighbouring chunk supplies them.
+            for g in range(G):
+                ix = ibase_ref[gbase + g, 0]
+                iy = ibase_ref[gbase + g, 1]
+                scratch_ref[g] = slab_ref[
+                    pl.ds(iy, K + 1), pl.ds(ix, K + 1), :
+                ]
+            patch = scratch_ref[...].astype(jnp.float32)
+            f1g = f1_ref[pl.ds(gbase, G), :].astype(jnp.float32)
+            corr = jnp.sum(patch * f1g[:, None, None, :], axis=-1)
+            fr = frac_ref[pl.ds(gbase, G), :]
+            fx = fr[:, 0][:, None, None]
+            fy = fr[:, 1][:, None, None]
+            win = (
+                (1 - fy) * (1 - fx) * corr[:, :K, :K]
+                + (1 - fy) * fx * corr[:, :K, 1:]
+                + fy * (1 - fx) * corr[:, 1:, :K]
+                + fy * fx * corr[:, 1:, 1:]
+            )
+            qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, (G, 1, 1), 0)
+            mask = (qpos >= lo) & (qpos < hi)
+            cur = out_ref[pl.ds(gbase, G)]
+            out_ref[pl.ds(gbase, G)] = cur + jnp.where(mask, win, 0.0)
+        return 0
+
+    jax.lax.fori_loop(0, out_ref.shape[0] // G, body, 0)
+
+
+def _banded_lookup_one_level(
+    f1: jax.Array,  # (B, N, C) pre-scaled query features, N = H*W
+    f2l: jax.Array,  # (B, Hl, Wl, C) pooled fmap2 level
+    coords: jax.Array,  # (B, N, 2)
+    radius: int,
+    level: int,
+    band_rows: int,
+    interpret: bool = False,
+    query_block: int | None = None,
+) -> jax.Array:
+    """Banded variant of :func:`_lookup_one_level` for levels whose
+    padded slab exceeds the resident VMEM budget (module docstring,
+    "Banded tier"). Bitwise-equal to the resident kernel: identical
+    per-query math, only regrouped — the parity is pinned by
+    tests/test_corr_pallas.py."""
+    B, N, C = f1.shape
+    _, Hl, Wl, _ = f2l.shape
+    fdt = f1.dtype
+    K = 2 * radius + 1
+    K1 = K + 1
+    halo = _band_halo(radius)
+    Hp, Wp, pad = _padded_hw(Hl, Wl, radius)
+    _, n_bands = _band_geometry(Hp, radius, band_rows)
+    # Zero-pad rows so every band slab (band_rows + halo rows from its
+    # first origin row) is in-bounds; the extra rows are zeros, i.e.
+    # exactly the margin the clamped-origin semantics already rely on.
+    extra = n_bands * band_rows + halo - Hp
+    f2p = jnp.pad(
+        f2l, ((0, 0), (pad, pad + extra), (pad, pad), (0, 0))
+    ).astype(fdt)
+
+    cl = coords.astype(jnp.float32) / (2.0**level)
+    c0 = jnp.floor(cl)
+    frac = cl - c0  # (B, N, 2): (fx, fy)
+    lim = jnp.asarray([Wp - K1, Hp - K1], jnp.int32)
+    ib = jnp.clip(c0.astype(jnp.int32) - radius + pad, 0, lim)
+    band_id = ib[..., 1] // band_rows  # (B, N)
+    # Window origins as the kernel reads them: x in the padded level,
+    # y LOCAL to the query's own band slab.
+    ibase = jnp.stack(
+        [ib[..., 0], ib[..., 1] - band_id * band_rows], axis=-1
+    )
+
+    # Stable argsort-by-band: queries of one band become contiguous (and
+    # keep raster order within it); the inverse permutation restores the
+    # caller's order after the kernel.
+    order = jnp.argsort(band_id, axis=1, stable=True)
+
+    def take(x):
+        return jnp.take_along_axis(x, order[..., None], axis=1)
+
+    f1_s, frac_s, ibase_s = take(f1), take(frac), take(ibase)
+    band_s = jnp.take_along_axis(band_id, order, axis=1)
+
+    qblk = query_block or effective_query_block()
+    qblk = min(qblk, max(_GROUP, (N + _GROUP - 1) // _GROUP * _GROUP))
+    qblk = max(qblk - qblk % _GROUP, _GROUP)
+    n_pad = (-N) % qblk
+    if n_pad:
+        f1_s = jnp.pad(f1_s, ((0, 0), (0, n_pad), (0, 0)))
+        frac_s = jnp.pad(frac_s, ((0, 0), (0, n_pad), (0, 0)))
+        ibase_s = jnp.pad(ibase_s, ((0, 0), (0, n_pad), (0, 0)))
+        # Padding queries ride the last band (edge mode) so they extend
+        # its final chunk instead of minting a fresh one; their ibase is
+        # (0, 0) — in-slab reads, results dropped by the [:N] slice.
+        band_s = jnp.pad(band_s, ((0, 0), (0, n_pad)), mode="edge")
+    Nq = N + n_pad
+    n_blocks = Nq // qblk
+
+    # Chunk table: the sorted query array cut at every query-block start
+    # and band change — the (band x query_block) grid with empty cells
+    # compressed out. At most n_blocks + n_bands - 1 segments; unused
+    # slots become dummy chunks (lo == hi == Nq, clamped to the last
+    # block and band, fresh=0) that fetch nothing new and mask all work.
+    n_chunks = n_blocks + n_bands - 1
+    pos = jnp.arange(Nq, dtype=jnp.int32)
+    newband = jnp.concatenate(
+        [jnp.ones((B, 1), bool), band_s[:, 1:] != band_s[:, :-1]], axis=1
+    )
+    is_start = newband | ((pos % qblk) == 0)[None, :]
+    starts = jnp.sort(
+        jnp.where(is_start, pos[None], Nq).astype(jnp.int32), axis=1
+    )[:, :n_chunks]
+    ends = jnp.minimum(
+        jnp.concatenate(
+            [starts[:, 1:], jnp.full((B, 1), Nq, jnp.int32)], axis=1
+        ),
+        Nq,
+    )
+    blk = jnp.minimum(starts // qblk, n_blocks - 1)
+    bnd = jnp.take_along_axis(
+        band_s, jnp.minimum(starts, Nq - 1), axis=1
+    ).astype(jnp.int32)
+    fresh = jnp.concatenate(
+        [
+            jnp.ones((B, 1), jnp.int32),
+            (bnd[:, 1:] != bnd[:, :-1]).astype(jnp.int32),
+        ],
+        axis=1,
+    )
+    fresh = jnp.where(starts < Nq, fresh, 0)  # dummies never DMA
+    tbl = jnp.stack([bnd, blk, starts, ends, fresh], axis=-1)
+
+    if pltpu is None:  # pragma: no cover - guarded by _forward dispatch
+        raise NotImplementedError(
+            "corr_lookup_pallas requires jax.experimental.pallas.tpu"
+        )
+    ibase_spec = pl.BlockSpec(
+        (None, qblk, 2),
+        lambda b, j, t: (b, t[b, j, 1], 0),
+        **({} if interpret else {"memory_space": _SMEM}),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, n_chunks),
+        in_specs=[
+            ibase_spec,
+            pl.BlockSpec(
+                (None, qblk, C), lambda b, j, t: (b, t[b, j, 1], 0)
+            ),
+            pl.BlockSpec(
+                (None, qblk, 2), lambda b, j, t: (b, t[b, j, 1], 0)
+            ),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # level stays in HBM
+        ],
+        out_specs=pl.BlockSpec(
+            (None, qblk, K, K), lambda b, j, t: (b, t[b, j, 1], 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((band_rows + halo, Wp, C), fdt),
+            pltpu.VMEM((_GROUP, K1, K1, C), fdt),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _banded_lookup_kernel,
+            radius=radius,
+            qblk=qblk,
+            band_rows=band_rows,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Nq, K, K), jnp.float32),
+        interpret=interpret,
+    )(
+        tbl,
+        ibase_s,
+        f1_s.astype(fdt),
+        frac_s.astype(jnp.float32),
+        f2p,
+    )
+    inv = jnp.argsort(order, axis=1)
+    out = jnp.take_along_axis(out, inv[..., None, None], axis=1)
+    # (B, N, K_y, K_x) -> x-major taps (reference order).
+    return out[:, :N].transpose(0, 1, 3, 2).reshape(B, N, K * K)
+
+
 def _forward(
     fmap1: jax.Array,
     fmap2: jax.Array,
@@ -267,10 +702,14 @@ def _forward(
     dtype=None,
 ) -> jax.Array:
     """Volume-free fused lookup over all pyramid levels, with PER-LEVEL
-    dispatch: levels whose padded slab fits VMEM at ``dtype``'s element
-    size take the kernel, the rest take the equivalent XLA on-the-fly
-    path (1080p levels 0-1 at f32; level 1 re-qualifies at bf16 —
-    tests/test_precision.py pins the threshold ratio)."""
+    THREE-TIER dispatch at ``dtype``'s element size: levels whose
+    padded slab fits VMEM take the resident kernel, levels too large
+    for residency but with a fitting :func:`band_plan` take the banded
+    kernel, and only the remainder takes the equivalent XLA on-the-fly
+    path (at 1080p f32 levels 0-1 are banded, 2-3 resident; at 4K every
+    level lands on a kernel tier — tests/test_pallas_lowering.py pins
+    the exact counts, tests/test_precision.py the bf16 threshold
+    ratios)."""
     from raft_ncup_tpu.ops.corr import _pool_fmap_pyramid, corr_lookup_onthefly
 
     B, H, W, C = fmap1.shape
@@ -280,10 +719,11 @@ def _forward(
     f2_levels = _pool_fmap_pyramid(fmap2.astype(dtype), num_levels)
     cflat = coords.astype(jnp.float32).reshape(B, H * W, 2)
 
+    qblk = effective_query_block()
     K2 = (2 * radius + 1) ** 2
     outs: dict[int, jax.Array] = {}
     fallback = []
-    _dispatch_counts["levels_total"] += num_levels
+    _count("levels_total", num_levels)
     if pltpu is None:
         # jax builds without pallas-tpu: the kernel can't declare its VMEM
         # scratch there even in interpret mode, so every level routes to
@@ -297,20 +737,30 @@ def _forward(
             stacklevel=2,
         )
     for lvl, f2l in enumerate(f2_levels):
-        if pltpu is not None and fits_vmem(
-            f2l.shape[1], f2l.shape[2], C, radius, dtype=dtype
-        ):
-            _dispatch_counts["kernel"] += 1
+        Hl, Wl = f2l.shape[1], f2l.shape[2]
+        if pltpu is not None and fits_vmem(Hl, Wl, C, radius, dtype=dtype):
+            _count("kernel")
             outs[lvl] = _lookup_one_level(
-                f1, f2l, cflat, radius, lvl, interpret=interpret
+                f1, f2l, cflat, radius, lvl, interpret=interpret,
+                query_block=qblk,
+            )
+        elif pltpu is not None and (
+            plan := band_plan(Hl, Wl, C, radius, dtype=dtype,
+                              query_block=qblk)
+        ):
+            _count("banded")
+            outs[lvl] = _banded_lookup_one_level(
+                f1, f2l, cflat, radius, lvl, band_rows=plan[0],
+                interpret=interpret, query_block=qblk,
             )
         else:
-            _dispatch_counts["fallback"] += 1
+            _count("fallback")
             fallback.append(lvl)
     if fallback:
         if pltpu is not None and len(fallback) == num_levels:
             # Same mislabeled-measurement hazard as the pltpu-is-None
-            # branch above: every level rejected by fits_vmem means
+            # branch above: every level rejected by BOTH kernel tiers
+            # (resident fits_vmem AND band_plan) means
             # corr_impl='pallas' is measuring pure XLA onthefly.
             import warnings
 
@@ -346,8 +796,9 @@ def corr_lookup_pallas(
     (B, H, W, L*(2r+1)^2) float32. Equivalent to the XLA paths in
     ``raft_ncup_tpu.ops.corr`` up to float associativity; never
     materializes the correlation volume. ``dtype`` (static; default
-    f32) is the feature/slab dtype the per-level VMEM dispatch budgets
-    with — the precision policy's ``corr_jnp``. The backward always
+    f32) is the feature/slab dtype the per-level THREE-TIER dispatch
+    (resident kernel -> banded kernel -> XLA onthefly) budgets with —
+    the precision policy's ``corr_jnp``. The backward always
     differentiates the f32 XLA path: gradients stay full precision
     regardless of the forward's storage dtype (f32 master weights)."""
     return _forward(

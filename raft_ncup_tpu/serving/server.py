@@ -6,7 +6,9 @@ Data path (one dispatcher thread, clients on their own threads):
 
 1. **submit** (client thread): cheap metadata validation (ndim / dtype /
    size caps — malformed requests are ``rejected`` before they occupy
-   queue capacity), pad-spec computation (``InputPadder`` with the
+   queue capacity; the default size ceiling is UHD 2176x3840, servable
+   since the banded corr tier broke the 4K memory wall — docs/PERF.md
+   "Banded dispatch"), pad-spec computation (``InputPadder`` with the
    configured bucket, so the request's batching key is its PADDED
    shape), then a non-blocking ``AdmissionQueue.offer`` — a full queue
    sheds with a ``retry_after_s`` hint derived from the live service-

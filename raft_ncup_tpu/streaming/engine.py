@@ -16,6 +16,10 @@ threads):
    strictly increasing), staleness decision (index gap >
    ``max_frame_gap`` ⇒ this frame is forced COLD — a stale warm start
    is worse than none), then a non-blocking ``AdmissionQueue.offer``.
+   Any /8 frame shape up to UHD (2176x3840) is a valid engine shape:
+   the banded corr tier keeps the 4K per-level lookup on-kernel and
+   the onthefly fallback bounds the working set, so a 4K slot table
+   warms like any other (docs/PERF.md "Banded dispatch").
 3. **assemble** (dispatcher): ``pop_batch(..., distinct_fn=stream)``
    pops a FIFO run of frames from DISTINCT streams — two frames of one
    stream must be chained through the slot table, never batched

@@ -36,9 +36,9 @@ def recommend(record: dict) -> list[str]:
             "corr_impl='volume', RAFT_NCUP_NCONV_IMPL='xla' pending TPU data"
         ] + _val_row_lines(record) + _serve_row_lines(record) + _bf16_row_lines(
             record
-        ) + _highres_row_lines(record) + _fleet_lines(
+        ) + _highres_row_lines(record) + _uhd_row_lines(
             record
-        ) + _telemetry_lines(record)
+        ) + _fleet_lines(record) + _telemetry_lines(record)
 
     corr = {"volume": record.get("value")}
     for tag in ("onthefly", "pallas"):
@@ -104,6 +104,7 @@ def recommend(record: dict) -> list[str]:
     lines.extend(_serve_row_lines(record))
     lines.extend(_bf16_row_lines(record))
     lines.extend(_highres_row_lines(record))
+    lines.extend(_uhd_row_lines(record))
     lines.extend(_fleet_lines(record))
     lines.extend(_telemetry_lines(record))
 
@@ -341,6 +342,74 @@ def _highres_row_lines(record: dict) -> list[str]:
         f"{record.get('highres_analysis_temp_gib_unsharded', '?')} GiB) "
         "— no mesh flip from CPU data; the row is staged for first "
         "hardware contact"
+    ]
+
+
+def _uhd_row_lines(record: dict) -> list[str]:
+    """UHD/4K row (bench.py ``uhd_*`` fields; docs/PERF.md "Banded
+    dispatch") — the corr-tier discipline at the shape the banded
+    kernel exists for: absent row → no lines (older records predate
+    it); dirty-or-missing guard counters → the window is unusable;
+    CPU → staged, never a flip (a CPU 4K window runs the XLA fallback
+    at reduced iters — it proves servability, not kernel ordering);
+    clean accelerator → the corr-tier verdict (which tier carried the
+    levels, and whether corr_impl='pallas' is the 4K candidate)."""
+    uhd = record.get("uhd_pairs_per_sec")
+    if uhd is None:
+        return []
+    transfers = record.get("uhd_host_transfers")
+    recompiles = record.get("uhd_recompiles")
+    if transfers or recompiles or transfers is None or recompiles is None:
+        return [
+            "uhd: INVARIANT VIOLATED (or unrecorded) during the 4K "
+            f"window ({transfers if transfers is not None else '?'} "
+            "implicit host transfer(s), "
+            f"{recompiles if recompiles is not None else '?'} "
+            "recompile(s)) — the uhd_* numbers are unusable; fix the "
+            "leak (docs/ANALYSIS.md) before reading them"
+        ]
+    impl = record.get("uhd_corr_impl", "?")
+    shape = record.get("uhd_shape", "?")
+    knobs = (
+        f"row_chunk={record.get('uhd_corr_row_chunk', '?')}, "
+        f"query_block={record.get('uhd_corr_query_block', '?')}, "
+        f"band_rows={record.get('uhd_corr_band_rows', '?')}"
+    )
+    key = str(record.get("baseline_key", ""))
+    on_accel = bool(key) and not key.startswith("cpu")
+    if not on_accel:
+        return [
+            f"uhd: 4K window clean on CPU ({uhd:.4f} pairs/s at "
+            f"{shape}/{record.get('uhd_iters', '?')}it via "
+            f"'{impl}'; {knobs}) — proves 4K is servable, says nothing "
+            "about kernel ordering; the corr-tier verdict is staged "
+            "for first hardware contact"
+        ]
+    dispatch = record.get("uhd_corr_dispatch") or {}
+    if impl == "pallas" and dispatch:
+        fb = dispatch.get("fallback", 0)
+        if fb:
+            return [
+                f"uhd: pallas window clean ({uhd:.3f} pairs/s) but "
+                f"{fb}/{dispatch.get('levels_total', '?')} pyramid "
+                "level(s) still fell back to XLA — tune the band knobs "
+                f"({knobs}; RAFT_NCUP_CORR_BAND_ROWS/"
+                "RAFT_NCUP_CORR_QUERY_BLOCK) before judging the 4K tier"
+            ]
+        return [
+            f"uhd: 4K corr tier VERDICT — '{impl}' carried every level "
+            f"on-kernel (resident {dispatch.get('kernel', 0)} + banded "
+            f"{dispatch.get('banded', 0)}; {uhd:.3f} pairs/s, "
+            f"invariants clean, {knobs}); corr_impl='pallas' is the 4K "
+            "default candidate — compare an onthefly rerun "
+            "(BENCH_UHD_CORR=onthefly) before flipping "
+            "ModelConfig.corr_impl for UHD serving"
+        ]
+    return [
+        f"uhd: accelerator window clean via '{impl}' ({uhd:.3f} "
+        f"pairs/s at {shape}; {knobs}) — rerun with "
+        "BENCH_UHD_CORR=pallas for the kernel-tier comparison before "
+        "any corr verdict"
     ]
 
 

@@ -26,13 +26,24 @@ aggregates every shard; per-device footprint is the ``analysis_*``
 fields. ``collectives``/``collective_bytes`` fingerprint the sharding
 (0/0 when unsharded).
 
+``--size 2176 3840`` is the UHD/4K configuration the banded Pallas
+corr tier (ops/corr_pallas.py; docs/PERF.md "Banded dispatch") exists
+for: with ``--corr_impl pallas`` the report's ``corr_dispatch`` field
+shows which tier (resident kernel / banded kernel / XLA fallback)
+carried each pyramid level, and the executed forward is the evidence
+that 4K fits and runs. ``--precision bf16_infer`` runs the same
+forward under the bf16 policy — halving the 4K working set — which
+was previously unmeasurable out-of-band.
+
 Usage:
     JAX_PLATFORMS=cpu python scripts/highres_forward.py [--iters 32]
         [--size 1088 1920] [--corr_impl onthefly] [--spatial 2]
+        [--precision f32]
 
-Prints one JSON line: shape, iters, mesh, compile_s, run_s (the
-executed forward, compile excluded), peak_rss_gib, per-device
-memory-analysis bytes and collective stats for the same executable.
+Prints one JSON line: shape, iters, mesh, precision, compile_s, run_s
+(the executed forward, compile excluded), peak_rss_gib, per-device
+memory-analysis bytes and collective stats for the same executable,
+plus corr_dispatch/corr_tuning when the Pallas tiers are in play.
 """
 
 from __future__ import annotations
@@ -56,6 +67,12 @@ def main(argv=None) -> int:
     p.add_argument("--iters", type=int, default=32)
     p.add_argument("--corr_impl", default="onthefly",
                    choices=["onthefly", "volume", "pallas"])
+    p.add_argument("--precision", default="f32",
+                   choices=["f32", "bf16_infer"],
+                   help="precision-policy preset the forward compiles "
+                   "under (docs/PRECISION.md); bf16_infer halves the "
+                   "corr working set and doubles the Pallas VMEM "
+                   "dispatch thresholds")
     p.add_argument("--spatial", type=int, default=1,
                    help="shard the image height over this many devices "
                    "(1 = unsharded). On CPU, forces this many virtual "
@@ -91,9 +108,22 @@ def main(argv=None) -> int:
             f"--spatial {args.spatial} must divide height/8 = {h // 8} "
             "(pad with InputPadder(divisor=8*spatial) first)"
         )
-    cfg = flagship_config(dataset="sintel", corr_impl=args.corr_impl)
+    cfg = flagship_config(
+        dataset="sintel", corr_impl=args.corr_impl,
+        precision=args.precision,
+    )
     model = get_model(cfg)
     variables = model.init(jax.random.PRNGKey(0), (1, 64, 64, 3))
+
+    corr_dispatch = None
+    if args.corr_impl == "pallas":
+        # Trace-time tier tally (resident kernel / banded / XLA
+        # fallback per pyramid level) — read after the single compile
+        # below, the one-reset-one-lowering discipline the counts
+        # document.
+        from raft_ncup_tpu.ops import corr_pallas as cpk
+
+        cpk.reset_dispatch_counts()
 
     mesh = (
         make_mesh(data=1, spatial=args.spatial,
@@ -107,6 +137,8 @@ def main(argv=None) -> int:
     t0 = time.perf_counter()
     compiled = step.lower(variables, img, img).compile()
     compile_s = time.perf_counter() - t0
+    if args.corr_impl == "pallas":
+        corr_dispatch = cpk.dispatch_counts()
     mem = compiled.memory_analysis()
     try:
         coll = collective_stats(compiled.as_text())
@@ -125,10 +157,13 @@ def main(argv=None) -> int:
     finite = bool(jnp.isfinite(up).all())
     # Linux ru_maxrss is KiB.
     peak_rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    from raft_ncup_tpu.ops.corr import corr_tuning_meta
+
     report = {
         "shape": [1, h, w, 3],
         "iters": args.iters,
         "corr_impl": args.corr_impl,
+        "precision": args.precision,
         "platform": jax.default_backend(),
         "mesh": mesh_fingerprint(mesh),
         "devices": args.spatial,
@@ -151,7 +186,13 @@ def main(argv=None) -> int:
             2,
         ),
         **coll,
+        "corr_tuning": corr_tuning_meta(),
     }
+    if corr_dispatch is not None:
+        # Which tier carried each pyramid level (three-tier dispatch,
+        # ops/corr_pallas.py): the 4K acceptance evidence is
+        # fallback == 0 — every level on a kernel tier.
+        report["corr_dispatch"] = corr_dispatch
     print(json.dumps(report), flush=True)
     return 0 if finite else 1
 

@@ -649,3 +649,90 @@ class TestFleetRow:
     def test_fleet_telemetry_overhead_absent_is_silent(self):
         lines = flip._fleet_lines(self._clean())
         assert len(lines) == 1
+
+
+class TestUhdRow:
+    """The uhd (4K) row verdict logic (docs/PERF.md "Banded dispatch"):
+    absent → silent, dirty counters → unusable, CPU → staged-never-
+    flip, clean accelerator → the corr-tier verdict."""
+
+    def _clean_cpu(self, **kw):
+        rec = {
+            "value": 9.0, "baseline_key": "cpu@host:volume:1x96x128x4",
+            "uhd_pairs_per_sec": 0.02, "uhd_shape": "1x2176x3840",
+            "uhd_iters": 1, "uhd_corr_impl": "onthefly",
+            "uhd_platform": "cpu", "uhd_corr_row_chunk": 8,
+            "uhd_corr_query_block": 512, "uhd_corr_band_rows": "auto",
+            "uhd_recompiles": 0, "uhd_host_transfers": 0,
+        }
+        rec.update(kw)
+        return rec
+
+    def _clean_accel(self, **kw):
+        rec = self._clean_cpu(
+            baseline_key="tpu@v5e:volume:2x368x768x12",
+            uhd_platform="tpu", uhd_corr_impl="pallas", uhd_iters=32,
+            uhd_pairs_per_sec=4.2,
+            uhd_corr_dispatch={
+                "kernel": 1, "banded": 3, "fallback": 0,
+                "levels_total": 4,
+            },
+        )
+        rec.update(kw)
+        return rec
+
+    def test_absent_row_adds_no_lines(self):
+        assert flip._uhd_row_lines({}) == []
+        assert not [
+            l for l in flip.recommend({"value": 1.0}) if l.startswith("uhd")
+        ]
+
+    def test_dirty_counters_make_row_unusable(self):
+        lines = flip._uhd_row_lines(self._clean_cpu(uhd_recompiles=2))
+        assert len(lines) == 1 and "INVARIANT VIOLATED" in lines[0]
+        lines = flip._uhd_row_lines(self._clean_cpu(uhd_host_transfers=1))
+        assert "INVARIANT VIOLATED" in lines[0]
+
+    def test_missing_counters_make_row_unusable(self):
+        rec = self._clean_cpu()
+        del rec["uhd_recompiles"]
+        lines = flip._uhd_row_lines(rec)
+        assert len(lines) == 1 and "unusable" in lines[0]
+
+    def test_cpu_row_is_staged_never_a_flip(self):
+        lines = flip._uhd_row_lines(self._clean_cpu())
+        assert len(lines) == 1
+        assert "staged" in lines[0] and "servable" in lines[0]
+        assert "FLIP" not in lines[0] and "VERDICT" not in lines[0]
+        # And through recommend() on a CPU record.
+        out = flip.recommend(self._clean_cpu())
+        assert any("uhd:" in l and "staged" in l for l in out)
+
+    def test_clean_accelerator_full_kernel_gives_corr_tier_verdict(self):
+        lines = flip._uhd_row_lines(self._clean_accel())
+        assert len(lines) == 1 and "VERDICT" in lines[0]
+        assert "banded 3" in lines[0] and "resident 1" in lines[0]
+        assert "corr_impl='pallas'" in lines[0]
+
+    def test_accelerator_partial_fallback_asks_for_tuning(self):
+        lines = flip._uhd_row_lines(self._clean_accel(
+            uhd_corr_dispatch={
+                "kernel": 1, "banded": 2, "fallback": 1,
+                "levels_total": 4,
+            },
+        ))
+        assert len(lines) == 1
+        assert "fell back" in lines[0]
+        assert "RAFT_NCUP_CORR_BAND_ROWS" in lines[0]
+
+    def test_accelerator_onthefly_row_asks_for_pallas_rerun(self):
+        lines = flip._uhd_row_lines(self._clean_accel(
+            uhd_corr_impl="onthefly", uhd_corr_dispatch=None,
+        ))
+        assert len(lines) == 1 and "BENCH_UHD_CORR=pallas" in lines[0]
+
+    def test_knobs_are_named_in_the_row(self):
+        rec = self._clean_cpu(uhd_corr_row_chunk=16,
+                              uhd_corr_band_rows=24)
+        (line,) = flip._uhd_row_lines(rec)
+        assert "row_chunk=16" in line and "band_rows=24" in line

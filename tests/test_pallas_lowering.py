@@ -71,10 +71,12 @@ class TestCorrLowering:
 
     def test_1080p_mixed_dispatch_lowers(self):
         """1088x1920 -> 136x240 1/8-res: levels 0 AND 1 exceed the
-        default VMEM budget (level 1's 68x120 padded slab needs ~15.29 MB
-        vs the 15.1 MB 0.9x budget) and fall back to XLA; levels 2-3
-        take the kernel — and the stitched graph lowers. Counts pinned
-        exactly so a gating change can't make this test pass vacuously."""
+        default VMEM RESIDENCY budget (level 1's 68x120 padded slab
+        needs ~15.29 MB vs the 15.1 MB 0.9x budget) and now take the
+        BANDED kernel — the correlation memory wall no longer demotes
+        the two largest levels to XLA; levels 2-3 stay resident — and
+        the stitched four-kernel graph lowers for a TPU target. Counts
+        pinned exactly so a gating change can't pass vacuously."""
         B, H, W, C = 1, 136, 240, 256
         g = np.random.default_rng(1)
         f1 = jnp.asarray(g.normal(size=(B, H, W, C)), jnp.float32)
@@ -87,8 +89,56 @@ class TestCorrLowering:
             f1, f2, coords,
         )
         counts = cpk.dispatch_counts()
-        assert counts["kernel"] == 2 and counts["fallback"] == 2
-        assert _count_mosaic_calls(text) == 2
+        assert counts["kernel"] == 2 and counts["banded"] == 2
+        assert counts["fallback"] == 0
+        assert _count_mosaic_calls(text) == 4
+
+    def test_4k_every_level_qualifies_for_a_kernel_tier(self):
+        """The ISSUE-15 residency pin: at 4K (2176x3840 -> 272x480
+        1/8-res, C=256) NO pyramid level is forced to the pure-XLA
+        fallback by the VMEM budget — at f32 or bf16. Exact tier split
+        pinned: f32 = 1 resident + 3 banded, bf16 = 2 + 2 (bf16 halves
+        the slab, so one more level re-qualifies for residency)."""
+        C = 256
+        levels_4k = [(272, 480), (136, 240), (68, 120), (34, 60)]
+        expect = {
+            None: (1, 3),           # f32: resident, banded
+            jnp.bfloat16: (2, 2),   # bf16
+        }
+        for dtype, (want_res, want_band) in expect.items():
+            resident = banded = 0
+            for h, w in levels_4k:
+                if cpk.fits_vmem(h, w, C, 4, dtype=dtype):
+                    resident += 1
+                else:
+                    plan = cpk.band_plan(h, w, C, 4, dtype=dtype)
+                    assert plan is not None, (h, w, dtype)
+                    band_rows, n_bands = plan
+                    assert cpk._banded_vmem_bytes(
+                        h, w, C, 4, band_rows,
+                        itemsize=2 if dtype is not None else 4,
+                    ) <= int(0.9 * cpk._VMEM_BYTES)
+                    banded += 1
+            assert (resident, banded) == (want_res, want_band), dtype
+
+    def test_4k_dispatch_counts_pinned_at_trace_time(self):
+        """Three-tier accounting at the 4K shape, pinned by an abstract
+        trace (eval_shape — dispatch is a trace-time choice, no
+        compile, no execution): f32 routes 1 level resident + 3 banded,
+        0 fallback."""
+        B, H, W, C = 1, 272, 480, 256
+        f1 = jax.ShapeDtypeStruct((B, H, W, C), jnp.float32)
+        f2 = jax.ShapeDtypeStruct((B, H, W, C), jnp.float32)
+        cds = jax.ShapeDtypeStruct((B, H, W, 2), jnp.float32)
+
+        cpk.reset_dispatch_counts()
+        jax.eval_shape(
+            lambda a, b, c: cpk.corr_lookup_pallas(a, b, c, 4, 4, False),
+            f1, f2, cds,
+        )
+        counts = cpk.dispatch_counts()
+        assert counts["kernel"] == 1 and counts["banded"] == 3
+        assert counts["fallback"] == 0 and counts["levels_total"] == 4
 
     def test_gradient_graph_lowers(self):
         """The custom-VJP backward graph must lower for TPU too."""

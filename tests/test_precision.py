@@ -193,6 +193,35 @@ class TestFitsVmemItemsize:
         assert not fits_vmem(band_h, 2 * band_h, c, r)
         assert fits_vmem(band_h, 2 * band_h, c, r, dtype=jnp.bfloat16)
 
+    def test_banded_budget_scales_exactly_with_itemsize(self):
+        """The band-budget extension of the itemsize contract: the
+        BANDED tier's VMEM bytes (_banded_vmem_bytes — single-buffered
+        band slab + query blocks + scratch) halve exactly at bf16, for
+        any band geometry."""
+        from raft_ncup_tpu.ops.corr_pallas import _banded_vmem_bytes
+
+        for h, w, c, br in (
+            (136, 240, 256, 8), (272, 480, 256, 8), (68, 120, 128, 32),
+        ):
+            assert (
+                2 * _banded_vmem_bytes(h, w, c, 4, br, itemsize=2)
+                == _banded_vmem_bytes(h, w, c, 4, br, itemsize=4)
+            )
+
+    def test_bf16_buys_wider_bands(self):
+        """Threshold ratio at the banded tier: bf16 halves the per-row
+        slab bytes, so band_plan's auto choice gets wider bands (fewer
+        bands, fewer slab DMAs) at the same budget — pinned at the 4K
+        and 1080p level-0 shapes."""
+        from raft_ncup_tpu.ops.corr_pallas import band_plan
+
+        for h, w in ((272, 480), (136, 240)):
+            f32_plan = band_plan(h, w, 256, 4)
+            b16_plan = band_plan(h, w, 256, 4, dtype=jnp.bfloat16)
+            assert f32_plan is not None and b16_plan is not None
+            assert b16_plan[0] > f32_plan[0]  # wider bands
+            assert b16_plan[1] <= f32_plan[1]  # never more bands
+
     def test_pallas_dispatch_uses_policy_dtype(self):
         """corr_lookup_pallas at a shape in the bf16-only band routes
         MORE levels to the kernel under the bf16 policy than under f32

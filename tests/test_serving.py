@@ -17,6 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from types import SimpleNamespace
+
 from raft_ncup_tpu.config import ServeConfig, small_model_config
 from raft_ncup_tpu.models.raft import RAFT
 from raft_ncup_tpu.resilience import PreemptionHandler
@@ -629,3 +631,28 @@ class TestRealModelServing:
         assert rb.flow.shape == (40, 48, 2)
         assert srv.stats.batches == 1  # same bucket -> one micro-batch
         assert srv._fwd.stats["compiles"] == 1
+
+
+class TestUhdAdmission:
+    """4K requests are admissible by default (docs/PERF.md "Banded
+    dispatch"): the ServeConfig ceiling is UHD 2176x3840 — the banded
+    corr tier broke the memory wall that justified the old 1088x1920
+    rejection — while oversized frames still reject crisply."""
+
+    def test_default_ceiling_is_uhd(self):
+        assert ServeConfig().max_image_hw == (2176, 3840)
+
+    def test_4k_passes_admission_validation(self):
+        server = _server()
+        try:
+            fake = SimpleNamespace(
+                shape=(2176, 3840, 3), dtype=np.float32
+            )
+            assert server._admission_error(fake) is None
+            too_big = SimpleNamespace(
+                shape=(2184, 3840, 3), dtype=np.float32
+            )
+            err = server._admission_error(too_big)
+            assert err is not None and "exceeds maximum" in err
+        finally:
+            server.drain()

@@ -125,3 +125,59 @@ def test_pallas_in_model_forward_on_tpu():
     )(variables, img, img)
     assert up.shape == (1, 96, 128, 2)
     assert bool(jnp.isfinite(up).all())
+
+
+def test_banded_tier_compiles_and_matches_on_tpu(monkeypatch):
+    """The BANDED tier Mosaic-compiled for real (docs/PERF.md "Banded
+    dispatch"): force residency off so every level takes the banded
+    kernel at the training-crop shape, and pin equivalence against the
+    volume path. This is the chip-window acceptance for the 4K tier —
+    the same kernel, DMA pattern, and chunk table that carry 1080p
+    levels 0-1 and all of 4K's large levels."""
+    from raft_ncup_tpu.ops import corr_pallas as cpk
+
+    monkeypatch.setattr(cpk, "fits_vmem", lambda *a, **k: False)
+    fmap1, fmap2, coords = _inputs(2)
+    ref = jax.jit(
+        lambda a, b, c: corr_lookup(
+            build_corr_pyramid(a, b, LEVELS), c, RADIUS
+        )
+    )(fmap1, fmap2, coords)
+    cpk.reset_dispatch_counts()
+    out = jax.jit(
+        lambda a, b, c: corr_lookup_pallas(a, b, c, RADIUS, LEVELS, False)
+    )(fmap1, fmap2, coords)
+    counts = cpk.dispatch_counts()
+    assert counts["banded"] == LEVELS and counts["fallback"] == 0
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_banded_timing_vs_resident_on_tpu(record_property, capsys, monkeypatch):
+    """Record (not gate) the banded tier's cost vs the resident kernel
+    at a shape both can run — the number item 1's autotuner needs to
+    price band_rows against residency."""
+    from raft_ncup_tpu.ops import corr_pallas as cpk
+
+    fmap1, fmap2, coords = _inputs(3)
+    t_res = _time(
+        jax.jit(
+            lambda a, b, c: corr_lookup_pallas(a, b, c, RADIUS, LEVELS, False)
+        ),
+        fmap1, fmap2, coords,
+    )
+    monkeypatch.setattr(cpk, "fits_vmem", lambda *a, **k: False)
+    t_band = _time(
+        jax.jit(
+            lambda a, b, c: corr_lookup_pallas(a, b, c, RADIUS, LEVELS, False)
+        ),
+        fmap1, fmap2, coords,
+    )
+    record_property("corr_lookup_resident_ms", round(t_res * 1e3, 3))
+    record_property("corr_lookup_banded_ms", round(t_band * 1e3, 3))
+    with capsys.disabled():
+        print(
+            f"\nbanded corr lookup @ {H8}x{W8}: resident={t_res*1e3:.2f}ms "
+            f"banded={t_band*1e3:.2f}ms"
+        )
