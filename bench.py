@@ -579,6 +579,21 @@ def _child_main() -> None:
         except Exception as e:  # never lose the earlier rows
             print(f"uhd bench failed: {e}", file=sys.stderr)
 
+    # Iteration-pipeline streaming row (docs/SHARDING.md "Pipeline
+    # axis"; ROADMAP item 2): micro-batches streamed through scan
+    # segments over the pipe mesh axis, with the collective-permute
+    # handoff fingerprint, per-segment ledger costs, and the standard
+    # guard counters. Budget-gated like the other tail rows;
+    # BENCH_SKIP_PIPELINE=1 turns it off explicitly.
+    if os.environ.get("BENCH_SKIP_PIPELINE") == "1":
+        pass
+    elif child_budget - (time.monotonic() - t0) > 0.12 * child_budget:
+        try:
+            record.update(_measure_pipeline(variables))
+            _emit(record)
+        except Exception as e:  # never lose the earlier rows
+            print(f"pipeline bench failed: {e}", file=sys.stderr)
+
 
 def _measure_bf16_forward(
     shape: dict, corr_impl: str, f32_forward, variables: dict,
@@ -1918,6 +1933,145 @@ def _measure_uhd(variables: dict, precision: str = "f32") -> dict:
     }
     if dispatch is not None:
         row["uhd_corr_dispatch"] = dispatch
+    return row
+
+
+def _measure_pipeline(variables: dict) -> dict:
+    """Guarded iteration-pipeline streaming row (docs/SHARDING.md
+    "Pipeline axis"; inference/pipe_schedule.py): micro-batches
+    streamed through S scan segments on an S-stage ``pipe`` mesh,
+    measured over a full warm stream (M micro-batches, M+S-1 ticks,
+    fill and flush INCLUDED — the honest steady-state figure a serving
+    deployment would see, not a cherry-picked middle tick).
+
+    Segment count: ``BENCH_PIPELINE_SEGMENTS`` wins, else the largest
+    of {4, 2} that the visible device count admits, else 1 — on a
+    single-device host the row records the monolithic delegation path,
+    clearly fingerprinted ``nomesh``/``pipeline_segments=1``. On CPU
+    the virtual pipeline stages share one host, so the S× throughput
+    claim is NOT measurable here (``pipeline_platform`` says so and
+    flip_recommendations stages rather than judges); what the CPU row
+    DOES pin is the guard-clean steady state and the
+    collective-permute handoff fingerprint.
+
+    Provenance: ``pipeline_mesh``/``pipeline_segments``/
+    ``pipeline_micro_batches``; the tick executable's per-segment cost
+    split from the ledger (``pipeline_flops_per_segment`` /
+    ``pipeline_bytes_per_segment`` — inference/costs.py); the
+    ``collective_stats`` per-op breakout of the WARMED tick
+    (``pipeline_collective_permutes`` — the carry-handoff traffic,
+    read at zero compile cost via ``tick_text``). When pipelined, a
+    monolithic comparison window (same pairs/iters, segments=1; skip
+    with ``BENCH_PIPELINE_COMPARE=0``) records
+    ``pipeline_pairs_per_sec_monolithic`` so flip_recommendations can
+    judge the pipeline from data; its guard counters fold into the
+    same two fields. Overrides: ``BENCH_PIPELINE_SIZE`` ("H,W"),
+    ``BENCH_PIPELINE_ITERS`` (quantized down to a multiple of S),
+    ``BENCH_PIPELINE_BATCHES``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_ncup_tpu.analysis.guards import (
+        GuardStats,
+        RecompileWatchdog,
+        forbid_host_transfers,
+    )
+    from raft_ncup_tpu.config import flagship_config
+    from raft_ncup_tpu.inference.costs import get_cost_ledger
+    from raft_ncup_tpu.inference.pipe_schedule import PipelinedForward
+    from raft_ncup_tpu.models.raft import get_model
+    from raft_ncup_tpu.parallel.mesh import (
+        collective_stats,
+        mesh_fingerprint,
+    )
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    env_s = os.environ.get("BENCH_PIPELINE_SEGMENTS")
+    if env_s:
+        segments = int(env_s)
+    else:
+        segments = next((s for s in (4, 2) if s <= n_dev), 1)
+    H, W = (
+        int(x)
+        for x in os.environ.get("BENCH_PIPELINE_SIZE", "256,448").split(",")
+    )
+    iters = int(
+        os.environ.get(
+            "BENCH_PIPELINE_ITERS", "32" if platform != "cpu" else "4"
+        )
+    )
+    # Budgets quantize to segment boundaries (serving/budget.py); so
+    # does the bench knob — down, never up (honest about work done).
+    iters = max(segments, iters - iters % segments)
+    micro = int(os.environ.get("BENCH_PIPELINE_BATCHES", str(2 * segments)))
+    strict = os.environ.get("BENCH_STRICT_GUARDS") == "1"
+
+    model = get_model(flagship_config(dataset="sintel", corr_impl="onthefly"))
+    rng = np.random.default_rng(11)
+    pairs = [
+        (
+            jnp.asarray(rng.uniform(0, 255, (1, H, W, 3)), jnp.float32),
+            jnp.asarray(rng.uniform(0, 255, (1, H, W, 3)), jnp.float32),
+        )
+        for _ in range(micro)
+    ]
+
+    def window(segs):
+        pf = PipelinedForward(model, variables, segments=segs)
+        # Warm stream outside the guards: compiles encode + tick (and
+        # the tiny scalar-slice sync program).
+        t0 = time.perf_counter()
+        outs = pf.forward_many(pairs, iters)
+        jax.device_get(outs[-1][1][0, 0, 0, 0])
+        warm_s = time.perf_counter() - t0
+        stats = GuardStats()
+        with RecompileWatchdog() as wd, forbid_host_transfers(
+            stats, raise_on_violation=strict
+        ):
+            t0 = time.perf_counter()
+            outs = pf.forward_many(pairs, iters)
+            # The one sanctioned explicit device_get: the honest sync.
+            jax.device_get(outs[-1][1][0, 0, 0, 0])
+            elapsed = time.perf_counter() - t0
+        return pf, {
+            "pairs_per_sec": round(micro / elapsed, 4) if elapsed else 0.0,
+            "warm_s": round(warm_s, 1),
+            "recompiles": wd.count,
+            "host_transfers": stats.host_transfers,
+        }
+
+    pf, main_w = window(segments)
+    row = {
+        "pipeline_pairs_per_sec": main_w["pairs_per_sec"],
+        "pipeline_segments": pf.segments,
+        "pipeline_micro_batches": micro,
+        "pipeline_shape": f"1x{H}x{W}",
+        "pipeline_iters": iters,
+        "pipeline_platform": platform,
+        "pipeline_mesh": mesh_fingerprint(pf.mesh),
+        "pipeline_warm_s": main_w["warm_s"],
+        "pipeline_recompiles": main_w["recompiles"],
+        "pipeline_host_transfers": main_w["host_transfers"],
+    }
+    hlo = pf.tick_text((1, H, W, 3), iters)
+    if hlo is not None:
+        cp = collective_stats(hlo)["by_op"]["collective-permute"]
+        row["pipeline_collective_permutes"] = cp["count"]
+        row["pipeline_collective_permute_bytes"] = cp["bytes"]
+    led = get_cost_ledger().lookup(kind="pipe_tick", segments=segments)
+    if led is not None:
+        row["pipeline_tick_flops"] = led.get("flops")
+        row["pipeline_flops_per_segment"] = led.get("flops_per_segment")
+        row["pipeline_bytes_per_segment"] = led.get("bytes_per_segment")
+        row["pipeline_tick_compile_ms"] = led.get("compile_ms")
+    if segments > 1 and os.environ.get("BENCH_PIPELINE_COMPARE") != "0":
+        _, ref = window(1)
+        row["pipeline_pairs_per_sec_monolithic"] = ref["pairs_per_sec"]
+        row["pipeline_recompiles"] += ref["recompiles"]
+        row["pipeline_host_transfers"] += ref["host_transfers"]
     return row
 
 

@@ -190,15 +190,30 @@ def _axes_in_tree(tree, aliases) -> set:
         for kw in node.keywords:
             if kw.arg == "axis_names":
                 cand = kw.value
-        elts = (
-            cand.elts
-            if isinstance(cand, (ast.Tuple, ast.List))
-            else [cand]
-        )
-        for e in elts:
-            if isinstance(e, ast.Constant) and isinstance(e.value, str):
-                axes.add(e.value)
+        axes |= _axis_literals(cand)
     return axes
+
+
+def _axis_literals(node) -> set:
+    """Literal axis-name strings reachable from one ``Mesh`` axis-names
+    expression. Descends conditional expressions — the production
+    declarer (parallel/mesh.py) declares its pipeline axis as
+    ``("data", "spatial", "pipe") if pipe > 1 else ("data", "spatial")``
+    and BOTH branches are real declarations (whichever the runtime
+    picks, a PartitionSpec naming 'pipe' is judged against a mesh that
+    can legally carry it)."""
+    out: set = set()
+    if isinstance(node, ast.IfExp):
+        out |= _axis_literals(node.body)
+        out |= _axis_literals(node.orelse)
+        return out
+    elts = (
+        node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    )
+    for e in elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            out.add(e.value)
+    return out
 
 
 def run_lint(

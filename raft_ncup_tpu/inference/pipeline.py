@@ -465,6 +465,18 @@ class ShapeCachedForward:
             return {"kind": "metrics", "shape": key[1], "iters": key[4],
                     "policy": key[8]}
         if key and key[0] == "custom":
+            # Pipeline programs (inference/pipe_schedule.py) get full
+            # structured identity: the tick's segment count rides into
+            # the ledger meta so costs.record_compiled can derive
+            # per-segment flops/bytes and flip_recommendations can
+            # judge the pipeline against the monolithic scan.
+            if len(key) >= 6 and key[1] == "pipe_tick":
+                return {"kind": "pipe_tick", "shape": key[2],
+                        "iters": key[3], "segments": key[4],
+                        "policy": key[5]}
+            if len(key) >= 4 and key[1] == "pipe_encode":
+                return {"kind": "pipe_encode", "shape": key[2],
+                        "policy": key[3]}
             return {"kind": "custom"}
         return {}
 
@@ -520,6 +532,10 @@ class ShapeCachedForward:
                         box["c"] = compiled
             return compiled(*args)
 
+        # Inspection handle (inference/pipe_schedule.tick_text; bench's
+        # sharding fingerprint): the warmed executable without a second
+        # lower().compile(). Empty until the first call.
+        warmed._compiled_box = box
         return warmed
 
     def _get(self, key, build):

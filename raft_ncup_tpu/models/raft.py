@@ -12,6 +12,16 @@ full-resolution NCUP passes don't hold live activations.
 Reference call structure: core/raft.py:87-143 (baseline) and
 core/raft_nc_dbl.py:115-173 (NCUP variant: mask head removed, per-iter
 nearest x2 -> NCUP x4 -> values x8).
+
+The refinement is COMPOSABLE (inference/pipe_schedule.py; docs/SHARDING.md
+"Pipeline axis"): ``encode`` produces a segment carry (GRU state, query
+coordinates, context features and the correlation feature maps for one
+micro-batch), ``refine_segment`` advances it by any contiguous block of
+iterations, and ``finalize`` upsamples the final carry — so N iterations
+can run as one monolithic scan (``apply``, unchanged semantics) or as S
+scan segments on S pipeline stages with the carry handed between device
+groups. All three share the same step body and upsampling head as
+``apply``, so segmented and monolithic execution agree by construction.
 """
 
 from __future__ import annotations
@@ -150,77 +160,11 @@ class RAFT:
             out["batch_stats"] = batch_stats
         return out
 
-    # ----------------------------------------------------------------- apply
+    # ------------------------------------------------- shared forward pieces
 
-    def apply(
-        self,
-        variables: dict,
-        image1: jax.Array,
-        image2: jax.Array,
-        iters: int = 12,
-        flow_init: Optional[jax.Array] = None,
-        test_mode: bool = False,
-        train: bool = False,
-        freeze_bn: bool = False,
-        rngs: Optional[dict] = None,
-        remat: bool = True,
-        mutable: bool = False,
-        mesh=None,
-        spatial_axis: str = "spatial",
-        metric_head: Optional[Any] = None,
-        net_init: Optional[jax.Array] = None,
-        net_warm: Optional[jax.Array] = None,
-        return_net: bool = False,
-    ):
-        """Estimate optical flow between a pair of NHWC image batches.
-
-        Returns (train mode) the stacked per-iteration high-res flow
-        predictions (iters, B, H, W, 2); (test_mode) the tuple
-        ``(flow_lowres, flow_up)``. With ``mutable=True`` additionally
-        returns the updated batch_stats as a second element.
-
-        ``metric_head`` (test mode only): a traceable callable applied to
-        the final high-res flow INSIDE this program; the second result
-        element becomes ``metric_head(flow_up)`` instead of the full
-        field. Evaluation folds its on-device metric accumulators
-        (inference/metrics.py) through this hook so the compiled eval
-        program emits a handful of scalars per batch — the full flow
-        field never leaves the device on the validation path.
-
-        ``net_init``/``net_warm``/``return_net`` (streaming warm start,
-        raft_ncup_tpu/streaming/): ``net_init`` is a (B, H/8, W/8,
-        hidden_dim) GRU hidden state carried from a previous frame;
-        rows where the (B,)-bool ``net_warm`` is True START the
-        refinement from it instead of the context encoder's
-        ``tanh`` initialization (a ``jnp.where`` select, so cold rows
-        are BITWISE the default cold start — the streaming engine's
-        per-stream isolation contract). ``return_net=True`` (test mode
-        only) appends the final hidden state to the result:
-        ``(flow_lr, flow_up, net)``.
-
-        ``mesh``/``spatial_axis``: when running under a (data x spatial)
-        SPMD mesh, the on-the-fly correlation lookup is wrapped in
-        ``jax.shard_map`` over the spatial axis — queries stay row-sharded
-        while fmap2 is replicated (33 MB at 1/8 res of 1080p). Left to the
-        GSPMD partitioner, the lookup's scan-over-row-chunks structure
-        partitions pathologically (6x the single-device temp memory,
-        measured in tests/test_highres.py); the explicit map makes spatial
-        sharding actually reduce per-device memory.
-        """
-        cfg = self.cfg
-        policy = self.policy
-        if image1.shape[1] % 8 or image1.shape[2] % 8:
-            raise ValueError(
-                f"image H, W must be divisible by 8, got {image1.shape[1:3]}; "
-                "pad inputs with raft_ncup_tpu.ops.InputPadder first"
-            )
-        params = variables["params"]
-        bstats = dict(variables.get("batch_stats", {}))
-        bn_train = train and not freeze_bn
-        hdim, cdim = cfg.hidden_dim, cfg.context_dim
-
-        img1 = 2.0 * (image1 / 255.0) - 1.0
-        img2 = 2.0 * (image2 / 255.0) - 1.0
+    def _make_run(self, params, bstats, bn_train, rngs):
+        """The submodule-application closure shared by every forward
+        entry point; mutates ``bstats`` in place when ``bn_train``."""
 
         def run(name, module, *args, **kwargs):
             # Only the upsampler may be parameter-free (bilinear head): its
@@ -240,6 +184,27 @@ class RAFT:
                 bstats[name] = mut["batch_stats"]
                 return out
             return module.apply(v, *args, rngs=rngs, **kwargs)
+
+        return run
+
+    def _encode(
+        self, run, image1, image2, *, train=False, bn_train=False,
+        flow_init=None, net_init=None, net_warm=None,
+    ):
+        """Everything before the first refinement iteration: normalize,
+        siamese fnet, context cnet, warm-start select, initial query
+        coordinates. Returns ``(fmap1, fmap2, net, inp, coords1)``."""
+        cfg = self.cfg
+        policy = self.policy
+        if image1.shape[1] % 8 or image1.shape[2] % 8:
+            raise ValueError(
+                f"image H, W must be divisible by 8, got {image1.shape[1:3]}; "
+                "pad inputs with raft_ncup_tpu.ops.InputPadder first"
+            )
+        hdim = cfg.hidden_dim
+
+        img1 = 2.0 * (image1 / 255.0) - 1.0
+        img2 = 2.0 * (image2 / 255.0) - 1.0
 
         # Siamese feature extraction: both frames through fnet in one batch
         # (reference: core/extractor.py:168-174). jax.named_scope labels
@@ -262,6 +227,38 @@ class RAFT:
         fmap1 = fmap1.astype(policy.corr_jnp)
         fmap2 = fmap2.astype(policy.corr_jnp)
 
+        with jax.named_scope("raft.cnet"):
+            cnet_out = run(
+                "cnet", self.cnet, img1, train=train, bn_train=bn_train
+            )
+        net = jnp.tanh(cnet_out[..., :hdim])
+        inp = jax.nn.relu(cnet_out[..., hdim:])
+        if net_init is not None:
+            # Carried GRU state replaces the cold init per batch row; the
+            # select (not arithmetic blend) keeps cold rows bitwise equal
+            # to a run without any carry. `inp` is deliberately NOT
+            # carried: it is the context encoding of the CURRENT frame —
+            # an input feature, not recurrent state — and reusing a stale
+            # frame's encoding would feed the update GRU wrong data.
+            carried = net_init.astype(net.dtype)
+            if net_warm is None:
+                net = carried
+            else:
+                net = jnp.where(
+                    net_warm[:, None, None, None], carried, net
+                )
+
+        B, H, W, _ = image1.shape
+        coords1 = coords_grid(B, H // 8, W // 8)
+        if flow_init is not None:
+            coords1 = coords1 + flow_init
+        return fmap1, fmap2, net, inp, coords1
+
+    def _build_corr_fn(self, fmap1, fmap2, mesh=None, spatial_axis="spatial"):
+        """Correlation-lookup closure over a micro-batch's feature maps,
+        per ``cfg.corr_impl`` (volume / onthefly / pallas)."""
+        cfg = self.cfg
+        policy = self.policy
         radius = cfg.resolved_corr_radius
         if cfg.corr_impl == "volume":
             pyramid = build_corr_pyramid(
@@ -347,61 +344,40 @@ class RAFT:
 
         else:
             raise ValueError(f"unknown corr_impl: {cfg.corr_impl!r}")
+        return corr_fn
 
-        with jax.named_scope("raft.cnet"):
-            cnet_out = run(
-                "cnet", self.cnet, img1, train=train, bn_train=bn_train
+    def _upsample(self, run, flow_lr, net, up_mask, bn_train=False):
+        """Low-res flow -> full-res prediction, per variant."""
+        cfg = self.cfg
+        policy = self.policy
+        if cfg.variant == "raft_nc_dbl":
+            # nearest x2, NCUP x4, values x8 (reference:
+            # core/raft_nc_dbl.py:107-112,161). The upsampler runs at
+            # the policy's pinned f32 — outside the reference's
+            # autocast region, and NCUP's confidence arithmetic is
+            # ratio-of-sums (docs/PRECISION.md).
+            flow2 = upsample_nearest(flow_lr, 2)
+            guidance = net.astype(policy.upsampler_jnp)
+            # The upsampler's only train-dependent piece is BatchNorm in
+            # the weights-estimation net, so it takes the bn flag.
+            hr = run(
+                "upsampler", self.upsampler, flow2, guidance, train=bn_train
             )
-        net = jnp.tanh(cnet_out[..., :hdim])
-        inp = jax.nn.relu(cnet_out[..., hdim:])
-        if net_init is not None:
-            # Carried GRU state replaces the cold init per batch row; the
-            # select (not arithmetic blend) keeps cold rows bitwise equal
-            # to a run without any carry. `inp` is deliberately NOT
-            # carried: it is the context encoding of the CURRENT frame —
-            # an input feature, not recurrent state — and reusing a stale
-            # frame's encoding would feed the update GRU wrong data.
-            carried = net_init.astype(net.dtype)
-            if net_warm is None:
-                net = carried
-            else:
-                net = jnp.where(
-                    net_warm[:, None, None, None], carried, net
-                )
+            return 8.0 * hr
+        if up_mask is None:
+            return upflow(flow_lr, 8, align_corners=cfg.align_corners)
+        return convex_upsample(
+            flow_lr, up_mask.astype(policy.upsampler_jnp), 8
+        )
 
-        B, H, W, _ = image1.shape
-        coords0 = coords_grid(B, H // 8, W // 8)
-        coords1 = coords_grid(B, H // 8, W // 8)
-        if flow_init is not None:
-            coords1 = coords1 + flow_init
-
-        def upsample_prediction(coords1, net, up_mask):
-            flow_lr = coords1 - coords0
-            if cfg.variant == "raft_nc_dbl":
-                # nearest x2, NCUP x4, values x8 (reference:
-                # core/raft_nc_dbl.py:107-112,161). The upsampler runs at
-                # the policy's pinned f32 — outside the reference's
-                # autocast region, and NCUP's confidence arithmetic is
-                # ratio-of-sums (docs/PRECISION.md).
-                flow2 = upsample_nearest(flow_lr, 2)
-                guidance = net.astype(policy.upsampler_jnp)
-                # The upsampler's only train-dependent piece is BatchNorm in
-                # the weights-estimation net, so it takes the bn flag.
-                hr = run(
-                    "upsampler", self.upsampler, flow2, guidance, train=bn_train
-                )
-                return 8.0 * hr
-            if up_mask is None:
-                return upflow(flow_lr, 8, align_corners=cfg.align_corners)
-            return convex_upsample(
-                flow_lr, up_mask.astype(policy.upsampler_jnp), 8
-            )
-
-        # The raft (non-small) variant's convex upsampling needs the final
-        # iteration's mask; in test mode the mask rides the scan carry so
-        # upsampling runs once after the loop instead of every iteration.
-        has_mask = cfg.variant == "raft" and not cfg.small
-        carry_mask = has_mask and test_mode
+    def _make_step(
+        self, run, corr_fn, coords0, inp, bstats, *, test_mode,
+        carry_mask, bn_train,
+    ):
+        """One refinement iteration on the ``(net, coords1, stats)``
+        carry — the single step body every scan (monolithic or segment)
+        runs, so segmented execution can never drift from ``apply``."""
+        policy = self.policy
 
         def step(carry, _):
             net, coords1, stats = carry
@@ -434,13 +410,102 @@ class RAFT:
             if test_mode:
                 out = None
             else:
-                out = upsample_prediction(coords1, net, up_mask)
+                out = self._upsample(
+                    run, coords1 - coords0, net, up_mask, bn_train
+                )
             new_stats = dict(stats)
             if "upsampler" in stats:
                 new_stats["upsampler"] = bstats["upsampler"]
             if carry_mask:
                 new_stats["up_mask"] = up_mask
             return (net, coords1, new_stats), out
+
+        return step
+
+    @property
+    def _has_mask(self) -> bool:
+        # The raft (non-small) variant's convex upsampling needs the final
+        # iteration's mask; in test mode the mask rides the scan carry so
+        # upsampling runs once after the loop instead of every iteration.
+        return self.cfg.variant == "raft" and not self.cfg.small
+
+    # ----------------------------------------------------------------- apply
+
+    def apply(
+        self,
+        variables: dict,
+        image1: jax.Array,
+        image2: jax.Array,
+        iters: int = 12,
+        flow_init: Optional[jax.Array] = None,
+        test_mode: bool = False,
+        train: bool = False,
+        freeze_bn: bool = False,
+        rngs: Optional[dict] = None,
+        remat: bool = True,
+        mutable: bool = False,
+        mesh=None,
+        spatial_axis: str = "spatial",
+        metric_head: Optional[Any] = None,
+        net_init: Optional[jax.Array] = None,
+        net_warm: Optional[jax.Array] = None,
+        return_net: bool = False,
+    ):
+        """Estimate optical flow between a pair of NHWC image batches.
+
+        Returns (train mode) the stacked per-iteration high-res flow
+        predictions (iters, B, H, W, 2); (test_mode) the tuple
+        ``(flow_lowres, flow_up)``. With ``mutable=True`` additionally
+        returns the updated batch_stats as a second element.
+
+        ``metric_head`` (test mode only): a traceable callable applied to
+        the final high-res flow INSIDE this program; the second result
+        element becomes ``metric_head(flow_up)`` instead of the full
+        field. Evaluation folds its on-device metric accumulators
+        (inference/metrics.py) through this hook so the compiled eval
+        program emits a handful of scalars per batch — the full flow
+        field never leaves the device on the validation path.
+
+        ``net_init``/``net_warm``/``return_net`` (streaming warm start,
+        raft_ncup_tpu/streaming/): ``net_init`` is a (B, H/8, W/8,
+        hidden_dim) GRU hidden state carried from a previous frame;
+        rows where the (B,)-bool ``net_warm`` is True START the
+        refinement from it instead of the context encoder's
+        ``tanh`` initialization (a ``jnp.where`` select, so cold rows
+        are BITWISE the default cold start — the streaming engine's
+        per-stream isolation contract). ``return_net=True`` (test mode
+        only) appends the final hidden state to the result:
+        ``(flow_lr, flow_up, net)``.
+
+        ``mesh``/``spatial_axis``: when running under a (data x spatial)
+        SPMD mesh, the on-the-fly correlation lookup is wrapped in
+        ``jax.shard_map`` over the spatial axis — queries stay row-sharded
+        while fmap2 is replicated (33 MB at 1/8 res of 1080p). Left to the
+        GSPMD partitioner, the lookup's scan-over-row-chunks structure
+        partitions pathologically (6x the single-device temp memory,
+        measured in tests/test_highres.py); the explicit map makes spatial
+        sharding actually reduce per-device memory.
+        """
+        policy = self.policy
+        params = variables["params"]
+        bstats = dict(variables.get("batch_stats", {}))
+        bn_train = train and not freeze_bn
+
+        run = self._make_run(params, bstats, bn_train, rngs)
+        fmap1, fmap2, net, inp, coords1 = self._encode(
+            run, image1, image2, train=train, bn_train=bn_train,
+            flow_init=flow_init, net_init=net_init, net_warm=net_warm,
+        )
+        corr_fn = self._build_corr_fn(fmap1, fmap2, mesh, spatial_axis)
+
+        B, H, W, _ = image1.shape
+        coords0 = coords_grid(B, H // 8, W // 8)
+
+        carry_mask = self._has_mask and test_mode
+        step = self._make_step(
+            run, corr_fn, coords0, inp, bstats,
+            test_mode=test_mode, carry_mask=carry_mask, bn_train=bn_train,
+        )
 
         init_stats: dict = {}
         if bn_train and "upsampler" in bstats:
@@ -463,8 +528,9 @@ class RAFT:
 
         if test_mode:
             with jax.named_scope("raft.upsample"):
-                flow_up = upsample_prediction(
-                    coords1, net, final_stats.get("up_mask")
+                flow_up = self._upsample(
+                    run, coords1 - coords0, net, final_stats.get("up_mask"),
+                    bn_train,
                 ).astype(policy.output_jnp)  # serving/metrics contract: f32
             if metric_head is not None:
                 with jax.named_scope("raft.metric_head"):
@@ -483,6 +549,120 @@ class RAFT:
         if mutable:
             return result, bstats
         return result
+
+    # ------------------------------------------- composable scan segments
+
+    def encode(
+        self,
+        variables: dict,
+        image1: jax.Array,
+        image2: jax.Array,
+        flow_init: Optional[jax.Array] = None,
+        net_init: Optional[jax.Array] = None,
+        net_warm: Optional[jax.Array] = None,
+        rngs: Optional[dict] = None,
+    ) -> dict:
+        """Pipeline front half (inference): everything before the first
+        refinement iteration, returned as a SEGMENT CARRY dict —
+
+        - ``net`` / ``coords1``: the live recurrent state a refinement
+          iteration mutates (plus ``up_mask`` for the raft non-small
+          variant, whose final-iteration mask the upsampler needs);
+        - ``inp`` / ``fmap1`` / ``fmap2``: the micro-batch's immutable
+          context, which must TRAVEL WITH the state between pipeline
+          stages (stage s+1 refining this micro-batch needs its feature
+          maps, not its neighbor's).
+
+        ``encode -> refine_segment x S -> finalize`` reproduces
+        ``apply(test_mode=True)`` exactly: same submodule code, same
+        step body, same upsampling head.
+        """
+        run = self._make_run(
+            variables["params"], dict(variables.get("batch_stats", {})),
+            False, rngs,
+        )
+        fmap1, fmap2, net, inp, coords1 = self._encode(
+            run, image1, image2,
+            flow_init=flow_init, net_init=net_init, net_warm=net_warm,
+        )
+        carry = {
+            "net": net, "coords1": coords1, "inp": inp,
+            "fmap1": fmap1, "fmap2": fmap2,
+        }
+        if self._has_mask:
+            B, h8, w8 = net.shape[:3]
+            carry["up_mask"] = jnp.zeros((B, h8, w8, 9 * 64), net.dtype)
+        return carry
+
+    def refine_segment(
+        self,
+        variables: dict,
+        carry: dict,
+        iters: int,
+        mesh=None,
+        spatial_axis: str = "spatial",
+        rngs: Optional[dict] = None,
+    ) -> dict:
+        """Advance a segment carry by ``iters`` contiguous refinement
+        iterations (one ``lax.scan`` — one compiled iteration body, as
+        in ``apply``) and return the updated carry. The correlation
+        closure is rebuilt from the carry's own feature maps, so a
+        carry handed in from another device group (or another jit
+        boundary) refines identically to one that never moved; for the
+        'volume' impl this re-derives the pyramid per segment — one
+        matmul + avg-pools, cheap against a segment of GRU iterations,
+        and bitwise the same pyramid every time."""
+        run = self._make_run(
+            variables["params"], dict(variables.get("batch_stats", {})),
+            False, rngs,
+        )
+        corr_fn = self._build_corr_fn(
+            carry["fmap1"], carry["fmap2"], mesh, spatial_axis
+        )
+        B, h8, w8 = carry["net"].shape[:3]
+        coords0 = coords_grid(B, h8, w8)
+        carry_mask = "up_mask" in carry
+        stats = {"up_mask": carry["up_mask"]} if carry_mask else {}
+        step = self._make_step(
+            run, corr_fn, coords0, carry["inp"], {},
+            test_mode=True, carry_mask=carry_mask, bn_train=False,
+        )
+        with jax.named_scope("raft.refinement"):
+            (net, coords1, out_stats), _ = jax.lax.scan(
+                step, (carry["net"], carry["coords1"], stats),
+                None, length=iters,
+            )
+        out = dict(carry)
+        out["net"] = net
+        out["coords1"] = coords1
+        if carry_mask:
+            out["up_mask"] = out_stats["up_mask"]
+        return out
+
+    def finalize(
+        self,
+        variables: dict,
+        carry: dict,
+        rngs: Optional[dict] = None,
+        return_net: bool = False,
+    ):
+        """Pipeline back half: upsample a finished segment carry to the
+        test-mode result ``(flow_lr, flow_up)`` (plus ``net`` with
+        ``return_net`` — the streaming warm-start handoff)."""
+        run = self._make_run(
+            variables["params"], dict(variables.get("batch_stats", {})),
+            False, rngs,
+        )
+        B, h8, w8 = carry["net"].shape[:3]
+        coords0 = coords_grid(B, h8, w8)
+        flow_lr = carry["coords1"] - coords0
+        with jax.named_scope("raft.upsample"):
+            flow_up = self._upsample(
+                run, flow_lr, carry["net"], carry.get("up_mask")
+            ).astype(self.policy.output_jnp)
+        if return_net:
+            return flow_lr, flow_up, carry["net"]
+        return flow_lr, flow_up
 
 
 @functools.lru_cache(maxsize=8)

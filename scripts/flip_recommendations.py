@@ -38,7 +38,9 @@ def recommend(record: dict) -> list[str]:
             record
         ) + _highres_row_lines(record) + _uhd_row_lines(
             record
-        ) + _fleet_lines(record) + _telemetry_lines(record)
+        ) + _pipeline_lines(record) + _fleet_lines(
+            record
+        ) + _telemetry_lines(record)
 
     corr = {"volume": record.get("value")}
     for tag in ("onthefly", "pallas"):
@@ -105,6 +107,7 @@ def recommend(record: dict) -> list[str]:
     lines.extend(_bf16_row_lines(record))
     lines.extend(_highres_row_lines(record))
     lines.extend(_uhd_row_lines(record))
+    lines.extend(_pipeline_lines(record))
     lines.extend(_fleet_lines(record))
     lines.extend(_telemetry_lines(record))
 
@@ -410,6 +413,86 @@ def _uhd_row_lines(record: dict) -> list[str]:
         f"pairs/s at {shape}; {knobs}) — rerun with "
         "BENCH_UHD_CORR=pallas for the kernel-tier comparison before "
         "any corr verdict"
+    ]
+
+
+def _pipeline_lines(record: dict) -> list[str]:
+    """Iteration-pipeline row (bench.py ``pipeline_*`` fields;
+    docs/SHARDING.md "Pipeline axis") — whether the pipe-axis streaming
+    schedule earns its mesh: absent row → no lines (older records
+    predate it); dirty-or-missing guard counters → the stream is
+    unusable; S=1 → the delegation path, nothing to judge; CPU →
+    staged, never a flip (virtual pipeline stages share one host — the
+    S× claim is unmeasurable, only the invariants and the
+    collective-permute fingerprint carry); clean accelerator → the
+    pipeline-vs-monolithic verdict at MARGIN."""
+    pps = record.get("pipeline_pairs_per_sec")
+    if pps is None:
+        return []
+    transfers = record.get("pipeline_host_transfers")
+    recompiles = record.get("pipeline_recompiles")
+    if transfers or recompiles or transfers is None or recompiles is None:
+        return [
+            "pipeline: INVARIANT VIOLATED (or unrecorded) during the "
+            "streaming window "
+            f"({transfers if transfers is not None else '?'} implicit "
+            "host transfer(s), "
+            f"{recompiles if recompiles is not None else '?'} "
+            "recompile(s)) — the pipeline_* numbers measure a stalling "
+            "stream; fix the leak (docs/ANALYSIS.md) before reading them"
+        ]
+    segs = record.get("pipeline_segments", "?")
+    shape = record.get("pipeline_shape", "?")
+    perm = record.get("pipeline_collective_permutes")
+    if segs == 1:
+        return [
+            f"pipeline: single-stage record ({pps:.4f} pairs/s at "
+            f"{shape} via the monolithic delegation path) — no pipe "
+            "mesh on this host; rerun with >1 visible device (or "
+            "BENCH_PIPELINE_SEGMENTS) for a pipeline measurement"
+        ]
+    handoff = (
+        f"{perm} collective-permute(s)/tick"
+        if perm is not None
+        else "handoff fingerprint unrecorded"
+    )
+    key = str(record.get("baseline_key", ""))
+    on_accel = bool(key) and not key.startswith("cpu")
+    if not on_accel:
+        return [
+            f"pipeline: S={segs} stream clean on CPU ({pps:.4f} "
+            f"pairs/s at {shape}/"
+            f"{record.get('pipeline_iters', '?')}it, "
+            f"{record.get('pipeline_micro_batches', '?')} micro-"
+            f"batches, {handoff}, invariants clean) — virtual stages "
+            "share one host, so this proves schedule correctness, not "
+            "throughput; the pipeline-vs-monolithic verdict is staged "
+            "for first hardware contact"
+        ]
+    mono = record.get("pipeline_pairs_per_sec_monolithic")
+    if not mono:
+        return [
+            f"pipeline: S={segs} accelerator stream clean ({pps:.3f} "
+            f"pairs/s, {handoff}) but no monolithic comparison window "
+            "in the record — rerun without BENCH_PIPELINE_COMPARE=0 "
+            "before any verdict"
+        ]
+    if pps >= MARGIN * mono:
+        return [
+            f"pipeline: VERDICT — S={segs} streaming beats the "
+            f"monolithic scan ({pps:.3f} vs {mono:.3f} pairs/s at "
+            f"{shape}; {handoff}; per-segment "
+            f"{record.get('pipeline_flops_per_segment', '?')} flops); "
+            "adopt the pipe mesh for streaming inference (ServeConfig "
+            "mesh=(1,1,S)) and sweep S per ROADMAP item 1's chip-window "
+            "checklist"
+        ]
+    return [
+        f"pipeline: keep the monolithic scan — S={segs} streaming "
+        f"({pps:.3f} pairs/s) does not clear the monolithic window "
+        f"({mono:.3f} pairs/s) by the {MARGIN}x margin; the handoff "
+        f"cost ({handoff}) is not yet paying for itself at this "
+        "shape/iters"
     ]
 
 

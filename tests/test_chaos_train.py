@@ -93,6 +93,11 @@ def _trajectory(log: str) -> dict:
     return out
 
 
+# Tier-2: ~80s (two full in-process train runs plus a resume). The
+# SIGTERM-save path itself stays tier-1 via the cheaper preemption
+# tests; the bitwise resumed-trajectory pin runs in the unfiltered
+# suite.
+@pytest.mark.slow
 def test_kill_resume_bitwise_identical_trajectory(tmp_path):
     """SIGTERM after step 4 ⇒ atomic checkpoint + EXIT_PREEMPTED; the
     resumed run's steps 5..7 match an uninterrupted run's bit-for-bit.

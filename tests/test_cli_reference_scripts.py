@@ -17,7 +17,23 @@ from raft_ncup_tpu.cli import parse_eval, parse_train
 
 _REF = "/root/reference"
 
-pytestmark = pytest.mark.reference
+# These tests parse the reference repo's OWN shell scripts, so they can
+# only run where that read-only checkout is mounted. Without the skip,
+# every container that lacks /root/reference turned the 6 tests into
+# perpetual tier-1 failures — environmental noise that buried real
+# regressions. The reason is loud on purpose: a skip here means "this
+# host can't check script-compat", never "script-compat is fine".
+pytestmark = [
+    pytest.mark.reference,
+    pytest.mark.skipif(
+        not os.path.isdir(_REF),
+        reason=(
+            f"reference checkout {_REF} is not mounted on this host — "
+            "CLI script-compat is UNVERIFIED here, not passing; run on a "
+            "host with the reference repo to exercise these pins"
+        ),
+    ),
+]
 
 
 def _extract_args(script: str, driver: str) -> list[str]:

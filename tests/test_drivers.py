@@ -278,6 +278,10 @@ class TestDemoDriver:
 
 
 class TestTrainDriver:
+    # Tier-2: ~47s (two full train.py main() invocations). Resume
+    # correctness stays tier-1 via test_checkpoint.py and the chaos
+    # preemption tests; this CLI-level composition runs unfiltered.
+    @pytest.mark.slow
     def test_train_resume_cycle(self, tmp_path, monkeypatch):
         """End-to-end composition through ``main(argv)``: loader, val
         cadence, checkpoint, restore (reference: train.py:167-261)."""

@@ -736,3 +736,92 @@ class TestUhdRow:
                               uhd_corr_band_rows=24)
         (line,) = flip._uhd_row_lines(rec)
         assert "row_chunk=16" in line and "band_rows=24" in line
+
+
+class TestPipelineRow:
+    """Iteration-pipeline streaming row (bench.py ``pipeline_*``;
+    docs/SHARDING.md "Pipeline axis"): absent row silent, dirty guards
+    poison it, S=1 is the delegation path, CPU stages the verdict for
+    the chip window, a clean accelerator row judges pipeline vs
+    monolithic at the margin."""
+
+    def _clean_cpu(self, **kw):
+        rec = {
+            "value": 9.0, "baseline_key": "cpu@host:volume:1x96x128x4",
+            "pipeline_pairs_per_sec": 0.8, "pipeline_segments": 4,
+            "pipeline_micro_batches": 8, "pipeline_shape": "1x256x448",
+            "pipeline_iters": 4, "pipeline_platform": "cpu",
+            "pipeline_mesh": "mesh(data=1,spatial=1,pipe=4:cpu)",
+            "pipeline_collective_permutes": 6,
+            "pipeline_recompiles": 0, "pipeline_host_transfers": 0,
+        }
+        rec.update(kw)
+        return rec
+
+    def _clean_accel(self, **kw):
+        rec = self._clean_cpu(
+            baseline_key="tpu@v5e:volume:2x368x768x12",
+            pipeline_platform="tpu", pipeline_iters=32,
+            pipeline_pairs_per_sec=12.0,
+            pipeline_pairs_per_sec_monolithic=4.0,
+            pipeline_mesh="mesh(data=1,spatial=1,pipe=4:tpu)",
+            pipeline_flops_per_segment=1.5e12,
+        )
+        rec.update(kw)
+        return rec
+
+    def test_absent_row_adds_no_lines(self):
+        assert flip._pipeline_lines({}) == []
+        assert not [
+            l for l in flip.recommend({"value": 1.0})
+            if l.startswith("pipeline")
+        ]
+
+    def test_dirty_counters_make_row_unusable(self):
+        lines = flip._pipeline_lines(self._clean_cpu(pipeline_recompiles=3))
+        assert len(lines) == 1 and "INVARIANT VIOLATED" in lines[0]
+        lines = flip._pipeline_lines(
+            self._clean_cpu(pipeline_host_transfers=2)
+        )
+        assert "INVARIANT VIOLATED" in lines[0]
+
+    def test_missing_counters_make_row_unusable(self):
+        rec = self._clean_cpu()
+        del rec["pipeline_host_transfers"]
+        (line,) = flip._pipeline_lines(rec)
+        assert "unusable" in line or "INVARIANT VIOLATED" in line
+
+    def test_single_stage_row_is_the_delegation_path(self):
+        (line,) = flip._pipeline_lines(
+            self._clean_cpu(pipeline_segments=1)
+        )
+        assert "single-stage" in line and "monolithic delegation" in line
+        assert "VERDICT" not in line
+
+    def test_cpu_row_is_staged_never_a_flip(self):
+        (line,) = flip._pipeline_lines(self._clean_cpu())
+        assert "staged" in line and "S=4" in line
+        assert "FLIP" not in line and "VERDICT" not in line
+        # Handoff fingerprint rides the staged line.
+        assert "collective-permute" in line
+        # And through recommend() on a CPU record.
+        out = flip.recommend(self._clean_cpu())
+        assert any("pipeline:" in l and "staged" in l for l in out)
+
+    def test_clean_accelerator_win_gives_verdict(self):
+        (line,) = flip._pipeline_lines(self._clean_accel())
+        assert "VERDICT" in line and "S=4" in line
+        assert "12.000 vs 4.000" in line
+
+    def test_accelerator_below_margin_keeps_monolithic(self):
+        (line,) = flip._pipeline_lines(self._clean_accel(
+            pipeline_pairs_per_sec=4.05,
+        ))
+        assert "keep the monolithic scan" in line
+
+    def test_accelerator_without_comparison_asks_for_rerun(self):
+        rec = self._clean_accel()
+        del rec["pipeline_pairs_per_sec_monolithic"]
+        (line,) = flip._pipeline_lines(rec)
+        assert "no monolithic comparison" in line
+        assert "BENCH_PIPELINE_COMPARE" in line

@@ -446,6 +446,73 @@ def test_jgl006_standalone_subsystem_negative_declared_axes(tmp_path):
     assert result.findings == []
 
 
+def test_jgl006_discovers_conditional_axis_tuple(tmp_path):
+    """Declared-axes discovery descends conditional-expression axis
+    tuples — ``Mesh(arr, (..., "pipe") if pipe > 1 else (...))`` is how
+    make_mesh declares the pipeline axis in ONE call (both branches
+    count as declarations), so 'pipe' must be usable in PartitionSpecs
+    without a JGL006 false positive, while a typo'd axis still fires."""
+    from raft_ncup_tpu.analysis.lint import run_lint
+
+    d = tmp_path / "pipe_ok"
+    d.mkdir()
+    (d / "mesh.py").write_text(
+        textwrap.dedent(
+            """
+            from jax.sharding import Mesh
+
+            def make(arr, pipe):
+                return Mesh(
+                    arr,
+                    ("data", "spatial", "pipe")
+                    if pipe > 1
+                    else ("data", "spatial"),
+                )
+            """
+        )
+    )
+    (d / "use.py").write_text(
+        textwrap.dedent(
+            """
+            from jax.sharding import PartitionSpec as P
+
+            STATE = P("pipe")
+            IMG = P("data", "spatial")
+            """
+        )
+    )
+    result = run_lint([str(d)])
+    assert result.declared_axes == frozenset({"data", "spatial", "pipe"})
+    assert result.findings == []
+
+    # negative half: an axis in NEITHER branch still fires
+    bad = tmp_path / "pipe_bad"
+    bad.mkdir()
+    (bad / "mesh.py").write_text((d / "mesh.py").read_text())
+    (bad / "use.py").write_text(
+        textwrap.dedent(
+            """
+            from jax.sharding import PartitionSpec as P
+
+            STATE = P("pip")   # typo: silently replicates
+            """
+        )
+    )
+    result = run_lint([str(bad)])
+    assert [f.rule for f in result.findings] == ["JGL006"]
+    assert "pip" in result.findings[0].message
+
+
+def test_jgl006_production_axes_include_pipe():
+    """The real make_mesh's conditional axis tuple feeds discovery: the
+    production fallback set must see all three axes, or every
+    P('pipe') in inference/pipe_schedule.py would be a false positive
+    in standalone subsystem lint runs."""
+    from raft_ncup_tpu.analysis.lint import production_declared_axes
+
+    assert production_declared_axes() >= {"data", "spatial", "pipe"}
+
+
 # --------------------------------------------------------------- JGL007
 
 

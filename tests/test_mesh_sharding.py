@@ -83,6 +83,92 @@ class TestMakeMesh:
         assert fp == "mesh(data=1,spatial=2:cpu)"
         assert fp != mesh_fingerprint(_mesh(2, 1))
 
+    def test_pipe_axis_mesh_and_fingerprint(self):
+        """The third axis (docs/SHARDING.md "Pipeline axis"): explicit
+        pipe>1 grows the mesh and the fingerprint; every compiled-
+        program key downstream inherits the distinction for free."""
+        mesh = make_mesh(
+            data=1, spatial=1, pipe=4, devices=jax.devices()[:4]
+        )
+        assert dict(mesh.shape) == {"data": 1, "spatial": 1, "pipe": 4}
+        assert mesh_fingerprint(mesh) == "mesh(data=1,spatial=1,pipe=4:cpu)"
+        # data=None spans all devices after spatial*pipe partitioning.
+        auto = make_mesh(spatial=1, pipe=4)
+        assert dict(auto.shape) == {"data": 2, "spatial": 1, "pipe": 4}
+        with pytest.raises(ValueError, match="not divisible by spatial"):
+            make_mesh(spatial=1, pipe=3)
+
+    def test_pipe_default_is_the_identical_two_axis_mesh(self):
+        """pipe=1 must yield the exact 2-axis mesh this function always
+        built — same axis names, same fingerprint — so no existing
+        cache key or bench provenance string changes under the
+        default."""
+        a = _mesh(1, 2)
+        b = make_mesh(
+            data=1, spatial=2, pipe=1, devices=jax.devices()[:2]
+        )
+        assert tuple(b.axis_names) == ("data", "spatial")
+        assert dict(a.shape) == dict(b.shape)
+        assert mesh_fingerprint(a) == mesh_fingerprint(b)
+
+    def test_resolve_config_mesh_accepts_pipe_triple(self):
+        from raft_ncup_tpu.parallel.mesh import resolve_config_mesh
+
+        mesh, div = resolve_config_mesh(None, (1, 1, 4))
+        assert dict(mesh.shape) == {"data": 1, "spatial": 1, "pipe": 4}
+        # The pipe axis never shards image dims: pad divisor is still
+        # 8 * spatial.
+        assert div == 8
+        mesh2, div2 = resolve_config_mesh(None, (1, 2))
+        assert dict(mesh2.shape) == {"data": 1, "spatial": 2}
+        assert div2 == 16
+
+
+# ------------------------------------------------------ collective_stats
+
+
+class TestCollectiveStats:
+    """Per-op-kind breakout (``by_op``) next to the aggregate counters
+    the highres/uhd bench rows already consume — pipeline handoffs
+    (collective-permute) must be attributable separately from halo
+    exchanges and fmap2 all-gathers."""
+
+    def test_by_op_breakout_and_aggregates(self):
+        from raft_ncup_tpu.parallel.mesh import collective_stats
+
+        hlo = (
+            "  %cp = f32[2,4]{1,0} collective-permute(%x), channel_id=1\n"
+            "  %cp2 = f32[2,4]{1,0} collective-permute-start(%y)\n"
+            "  %cp3 = f32[2,4]{1,0} collective-permute-done(%cp2)\n"
+            "  %ag = bf16[8]{0} all-gather(%z), dimensions={0}\n"
+            "  not_an_op collective-permute(%q)\n"
+        )
+        cs = collective_stats(hlo)
+        cp = cs["by_op"]["collective-permute"]
+        # The -done half of the async pair (and the no-result line)
+        # must not double count.
+        assert cp == {"count": 2, "bytes": 2 * (2 * 4 * 4)}
+        assert cs["by_op"]["all-gather"] == {"count": 1, "bytes": 16}
+        assert cs["collectives"] == 3
+        assert cs["collective_bytes"] == 64 + 16
+
+    def test_unsharded_program_is_all_zeros(self):
+        """Existing consumers (bench ``highres_collectives`` /
+        ``highres_collective_bytes``, scripts/highres_forward.py) index
+        the named aggregate keys; every op kind is present zero-filled
+        so by_op consumers never need existence guards."""
+        from raft_ncup_tpu.parallel.mesh import (
+            _COLLECTIVE_OPS,
+            collective_stats,
+        )
+
+        cs = collective_stats("%r = f32[4]{0} add(%a, %b)\n")
+        assert cs["collectives"] == 0 and cs["collective_bytes"] == 0
+        assert set(cs["by_op"]) == set(_COLLECTIVE_OPS)
+        assert all(
+            v == {"count": 0, "bytes": 0} for v in cs["by_op"].values()
+        )
+
 
 # -------------------------------------------------- cache-key isolation
 
@@ -137,10 +223,28 @@ class TestMeshKeyedCache:
         from raft_ncup_tpu.cli import str2mesh
 
         assert str2mesh("1,2") == (1, 2)
+        assert str2mesh("1,1,2") == (1, 1, 2)
         with pytest.raises(argparse.ArgumentTypeError):
             str2mesh("2")
         with pytest.raises(argparse.ArgumentTypeError):
             str2mesh("0,2")
+        with pytest.raises(argparse.ArgumentTypeError):
+            str2mesh("1,1,0")
+        with pytest.raises(argparse.ArgumentTypeError):
+            str2mesh("1,1,2,2")
+
+    def test_cli_mesh_triple_builds_pipe_mesh(self):
+        import argparse
+
+        from raft_ncup_tpu.cli import mesh_from_args
+
+        mesh = mesh_from_args(argparse.Namespace(mesh=(1, 1, 2)))
+        assert dict(mesh.shape) == {"data": 1, "spatial": 1, "pipe": 2}
+        # The 2-tuple path still yields the identical 2-axis mesh.
+        assert mesh_from_args(argparse.Namespace(mesh=(1, 2))).axis_names == (
+            "data",
+            "spatial",
+        )
 
 
 # ------------------------------------------------------ forward parity
