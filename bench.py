@@ -444,6 +444,25 @@ def _child_main() -> None:
         except Exception as e:  # never lose the earlier rows
             print(f"fleet bench failed: {e}", file=sys.stderr)
 
+    # Elasticity row (docs/FLEET.md "Elasticity bench"; ROADMAP item 3):
+    # the SLO-driven autoscaler replaying the deterministic low→high→
+    # cooldown traffic step on a real min=1/max=2 fleet — did the step
+    # force a scale-up, how long to READY, did the calm give capacity
+    # back with zero in-flight loss, and were warmup-window sheds
+    # ETA-floored. The row MEASURES the robustness machinery (sheds are
+    # expected; losses/violations disqualify it — the inverse of the
+    # fleet row's steady-state discipline). Spawns processes and rides
+    # out a spawn compile, hence the generous gate;
+    # BENCH_SKIP_ELASTICITY=1 turns it off explicitly.
+    if os.environ.get("BENCH_SKIP_ELASTICITY") == "1":
+        pass
+    elif child_budget - (time.monotonic() - t0) > 0.3 * child_budget:
+        try:
+            record.update(_measure_elasticity(shape, corr_impl))
+            _emit(record)
+        except Exception as e:  # never lose the earlier rows
+            print(f"elasticity bench failed: {e}", file=sys.stderr)
+
     # bf16 rows (docs/PRECISION.md; ROADMAP item 3): the same guarded
     # forward / train-loop / val / serve / stream measurements re-run
     # under the precision policy's bf16 presets, every key suffixed
@@ -1651,6 +1670,243 @@ def _measure_fleet(shape: dict, corr_impl: str) -> dict:
                 100.0 * (p50_on - p50_off) / p50_off, 2
             )
     return record
+
+
+def _measure_elasticity(shape: dict, corr_impl: str) -> dict:
+    """Guarded elasticity row (docs/FLEET.md "Elasticity bench";
+    ROADMAP item 3): the SLO-driven autoscaler driven by the
+    deterministic low→high→cooldown traffic step
+    (raft_ncup_tpu/traffic.py StepTraffic.step — the same schedule the
+    acceptance tests replay) on a REAL fleet: serve.py replica
+    processes, wire sockets, spawn-time compile warmup, the exit-75
+    drain contract.
+
+    Where the fleet row must measure SERVICE (any shed disqualifies
+    it), this row must measure the MACHINERY. It answers the three
+    elasticity questions with numbers flip_recommendations judges:
+
+    - did the load step force a scale-up, and how long until the new
+      capacity was READY (``elasticity_time_to_ready_s`` — measured
+      spawn→READY, the same estimate shed hints are floored at)?
+    - did the post-burst calm give capacity back
+      (``elasticity_scale_downs``) with ZERO in-flight loss
+      (``elasticity_losses`` — responses neither served nor honestly
+      shed — must be 0; drain-contract violations disqualify the row)?
+    - what did clients experience through both transitions (per-phase
+      ok/shed split, overall p50/p99; sheds during the warmup window
+      are honest backpressure but must carry a ``retry_after_s``
+      floored above the default — ``elasticity_shed_eta_floored``)?
+
+    The fleet starts at min_replicas=1 with max_replicas=2: the high
+    phase MUST overload the single replica (its interval is calibrated
+    to a fraction of the measured per-pair service time), and the
+    cooldown phase plus a bounded settle window must let the autoscaler
+    give the burst capacity back. BENCH_ELASTICITY_HIGH (default 18) /
+    BENCH_ELASTICITY_LOW (default 4) size the phases,
+    BENCH_ELASTICITY_GRACE_S (default 120) bounds the settle window,
+    and BENCH_SKIP_ELASTICITY=1 turns the row off.
+    """
+    import numpy as np
+
+    from raft_ncup_tpu.config import ServeConfig
+    from raft_ncup_tpu.data.synthetic import SyntheticFlowDataset
+    from raft_ncup_tpu.fleet import (
+        FleetAutoscaler,
+        FleetConfig,
+        FleetRouter,
+        ReplicaSupervisor,
+    )
+    from raft_ncup_tpu.observability import Telemetry
+    from raft_ncup_tpu.serving import nearest_rank_ms
+    from raft_ncup_tpu.traffic import StepTraffic
+
+    H, W = shape["height"], shape["width"]
+    iters = shape["iters"]
+    low_n = int(os.environ.get("BENCH_ELASTICITY_LOW", "4"))
+    high_n = int(os.environ.get("BENCH_ELASTICITY_HIGH", "48"))
+    grace_s = float(os.environ.get("BENCH_ELASTICITY_GRACE_S", "120"))
+    platform = os.environ.get("_BENCH_FORCE_PLATFORM") or "cpu"
+
+    import tempfile
+
+    base = tempfile.mkdtemp(prefix="bench_elasticity_")
+    cfg = FleetConfig(
+        base_dir=base,
+        n_replicas=1,          # start at the floor: the step must EARN
+        min_replicas=1,        # the second replica
+        max_replicas=2,
+        size_hw=(H, W),
+        serve=ServeConfig(
+            queue_capacity=max(8, high_n), batch_sizes=(1, 2),
+            iter_levels=(iters,), recover_patience=2,
+        ),
+        stream=None,
+        extra_args=(
+            "--model", "raft_nc_dbl", "--corr_impl", corr_impl,
+            "--platform", platform,
+        ),
+        snapshot_interval_s=0.5,
+        # Tight admission so the high phase saturates one replica, and
+        # reactive anti-flap bounds sized for a one-burst window (the
+        # production defaults assume minutes-long burns).
+        max_inflight_per_replica=3,
+        scale_hysteresis_ticks=2,
+        scale_cooldown_s=1.0,
+        scale_tick_s=0.25,
+    )
+    tel = Telemetry()
+    sup = ReplicaSupervisor(cfg, telemetry=tel)
+    ds = SyntheticFlowDataset((H, W), length=4, seed=131, style="rigid")
+    try:
+        sup.start()  # one replica, warm
+        router = FleetRouter(cfg, sup, telemetry=tel)
+        sc = FleetAutoscaler(cfg, sup, router, telemetry=tel)
+
+        # Calibrate the step against THIS host's service time: the high
+        # phase arrives 4x faster than one replica serves, the low
+        # phases comfortably slower — the rate step is the scenario, the
+        # absolute rate is the host's.
+        t0 = time.perf_counter()
+        for i in range(2):
+            s = ds.sample(i)
+            router.submit(
+                np.asarray(s["image1"], np.float32),
+                np.asarray(s["image2"], np.float32),
+            ).result(timeout=120.0)
+        per_pair = (time.perf_counter() - t0) / 2.0
+        high_interval = max(0.001, per_pair / 2.0)
+        traffic = StepTraffic.step(
+            (H, W), low_n=low_n, high_n=high_n,
+            low_interval_s=max(0.05, per_pair * 1.5),
+            high_interval_s=high_interval,
+            seed=131, style="rigid",
+        )
+        items = list(traffic.schedule())
+
+        # Replay the schedule with the control loop interleaved on its
+        # own cadence (manual ticks — deterministic accounting, no
+        # background thread racing the submit loop). The cadence must
+        # land several ticks INSIDE the high phase — hysteresis needs
+        # consecutive pressure observations, and a burst shorter than
+        # one tick is invisible to the loop by design.
+        tick_every = min(
+            cfg.scale_tick_s, max(0.02, high_n * high_interval / 8.0)
+        )
+        handles = []
+        last_tick = -tick_every
+        t0 = time.perf_counter()
+        for item in items:
+            while True:
+                now = time.perf_counter() - t0
+                if now - last_tick >= tick_every:
+                    sc.tick()
+                    last_tick = now
+                if now >= item.due_s:
+                    break
+                time.sleep(min(0.01, item.due_s - now))
+            handles.append(router.submit(item.image1, item.image2))
+        # Settle: keep ticking until every initiated topology change
+        # resolved AND the burst capacity was given back (or the grace
+        # window expires — the record then shows the open cycle).
+        deadline = time.perf_counter() + grace_s
+        while time.perf_counter() < deadline:
+            sc.tick()
+            rep = sc.report()
+            settled = (
+                rep["scale_ups"]
+                == rep["scale_ups_completed"] + rep["failed_scale_ups"]
+                and rep["scale_downs"] >= rep["scale_ups_completed"]
+                and router.pending_count() == 0
+            )
+            if settled:
+                break
+            time.sleep(tick_every)
+        responses = [h.result(timeout=60.0) for h in handles]
+        dt = time.perf_counter() - t0
+        sc.stop()  # clears the published ETA
+        rreport = router.report()
+        screport = sc.report()
+        router.drain()
+    finally:
+        reports = sup.stop()
+
+    lat = [
+        r.latency_s for r in responses if r.ok and r.latency_s is not None
+    ]
+    if not lat:
+        raise RuntimeError(
+            f"no ok responses in elasticity window: {rreport['stats']}"
+        )
+    statuses: dict = {}
+    for r in responses:
+        statuses[r.status] = statuses.get(r.status, 0) + 1
+    per_phase = {p.name: {"ok": 0, "shed": 0, "other": 0}
+                 for p in traffic.phases}
+    for item, r in zip(items, responses):
+        bucket = per_phase[item.phase]
+        key = r.status if r.status in ("ok", "shed") else "other"
+        bucket[key] += 1
+    sup_report = sup.report()
+    # Guard counters from EVERY replica that served the window: retired
+    # (scaled-down) replicas report via their drain's final JSON line,
+    # survivors via teardown — a leaking replica poisons the row either
+    # way.
+    served = sorted(
+        [(h.index, h.final_report or {}) for h in sup.retired]
+        + [(i, (r or {}).get("report") or {}) for i, r in reports.items()]
+    )
+    return {
+        "elasticity_requests": len(items),
+        "elasticity_ok": len(lat),
+        "elasticity_shed": statuses.get("shed", 0),
+        "elasticity_errors": statuses.get("error", 0),
+        "elasticity_timeouts": statuses.get("timeout", 0),
+        # A loss is any response neither served nor honestly shed:
+        # errors, timeouts, rejections, router-drain strandings.
+        "elasticity_losses": sum(
+            1 for r in responses if r.status not in ("ok", "shed")
+        ),
+        "elasticity_p50_ms": nearest_rank_ms(lat, 0.50),
+        "elasticity_p99_ms": nearest_rank_ms(lat, 0.99),
+        "elasticity_window_s": round(dt, 2),
+        "elasticity_per_phase": per_phase,
+        "elasticity_scale_ups": screport["scale_ups"],
+        "elasticity_scale_ups_completed": screport["scale_ups_completed"],
+        "elasticity_scale_downs": screport["scale_downs"],
+        "elasticity_failed_scale_ups": screport["failed_scale_ups"],
+        "elasticity_breaker_open": screport["breaker_open"],
+        "elasticity_time_to_ready_s": screport["time_to_ready_s"],
+        "elasticity_time_to_ready_observed": (
+            screport["time_to_ready_observed"]
+        ),
+        "elasticity_ticks": screport["ticks"],
+        # Backpressure honesty: sheds whose hint was floored ABOVE the
+        # 250ms default — during a cold scale-up that floor is the
+        # autoscaler's published time-to-READY estimate.
+        "elasticity_shed_eta_floored": sum(
+            1 for r in responses
+            if r.status == "shed"
+            and (r.retry_after_s or 0.0) > cfg.default_retry_after_s
+        ),
+        "elasticity_failovers": rreport["stats"].get("failovers", 0),
+        "elasticity_deaths": sup_report["deaths"],
+        "elasticity_restarts": sup_report["restarts"],
+        "elasticity_contract_violations": (
+            sup_report["contract_violations"]
+        ),
+        "elasticity_replica_recompiles": [
+            rep.get("recompiles") for _, rep in served
+        ],
+        "elasticity_replica_host_transfers": [
+            rep.get("host_transfers") for _, rep in served
+        ],
+        "elasticity_interval_high_ms": round(
+            traffic.phases[1].interval_s * 1e3, 1
+        ),
+        "elasticity_interval_low_ms": round(
+            traffic.phases[0].interval_s * 1e3, 1
+        ),
+    }
 
 
 def _measure_highres(variables: dict, precision: str = "f32") -> dict:

@@ -17,7 +17,10 @@ array can add a device sync to every request it routes.
   The supervisor, the router, bench, chaos, and the tests all read THIS
   object; nothing else defines the fleet's shape.
 - :mod:`wire` — the socket frame protocol: length-prefixed JSON header
-  + raw C-order ndarray payloads over a Unix domain socket.
+  + raw C-order ndarray payloads over a Unix domain socket or a TCP
+  connection (:class:`wire.Transport` parses the family from the one
+  address string both ends share; TCP links are hardened with connect
+  timeouts, keepalive, and boundary-vs-mid-frame read deadlines).
 - :mod:`replica` — :class:`ChildProcess` (the one process-lifecycle
   implementation: spawn, liveness/healthz wait, drain, reap — shared
   with the 4-process distributed test rig) and
@@ -30,11 +33,29 @@ array can add a device sync to every request it routes.
   healthz-advertised warmed executable sets, DRAINING/DEGRADED-aware
   rotation, and deadline-respecting single-failover retry — same
   five-status terminal protocol as ``serving/request.py``.
+- :mod:`host_supervisor` — the multi-host control plane: a per-host
+  :class:`HostSupervisor` agent (the unmodified ReplicaSupervisor over
+  that host's slots + a wire republish of their healthz) and the
+  router-side :class:`FleetManager` (fleet-level staleness: a silent
+  host is a dead host — fenced, failed over).
+- :mod:`autoscaler` — :class:`FleetAutoscaler`: the SLO-driven elastic
+  sizing loop (occupancy/burn/shed signals, hysteresis + cooldown,
+  scale-up through the READY pre-warm gate, scale-down through the
+  zero-loss drain contract, fail-budget breaker, time-to-READY ETA
+  published to the router's shed hints).
 
-Chaos: ``killreplica@N`` / ``stallreplica@N`` / ``drainreplica@N``
+Chaos: ``killreplica@N`` / ``stallreplica@N`` / ``drainreplica@N`` +
+the fleet-scale ``partitionhost@N`` / ``killsupervisor@N``
 (resilience/chaos.py) drive the blast-radius tests in
-tests/test_fleet.py. Bench: the guarded ``fleet_*`` row in bench.py.
+tests/test_fleet.py. Bench: the guarded ``fleet_*`` and
+``elasticity_*`` rows in bench.py.
 """
+
+from raft_ncup_tpu.fleet.autoscaler import FleetAutoscaler  # noqa: F401
+from raft_ncup_tpu.fleet.host_supervisor import (  # noqa: F401
+    FleetManager,
+    HostSupervisor,
+)
 
 from raft_ncup_tpu.fleet.replica import (  # noqa: F401
     ChildProcess,
@@ -49,12 +70,20 @@ from raft_ncup_tpu.fleet.topology import (  # noqa: F401
     ReplicaSpec,
     padded_shape,
 )
-from raft_ncup_tpu.fleet.wire import recv_msg, send_msg  # noqa: F401
+from raft_ncup_tpu.fleet.wire import (  # noqa: F401
+    Transport,
+    recv_msg,
+    send_msg,
+)
 
 __all__ = [
     "ChildProcess",
+    "FleetAutoscaler",
     "FleetConfig",
+    "FleetManager",
     "FleetRouter",
+    "HostSupervisor",
+    "Transport",
     "ReplicaHandle",
     "ReplicaSpec",
     "ReplicaSupervisor",

@@ -305,6 +305,7 @@ class ReplicaSupervisor:
         env: Optional[dict] = None,
         on_death: Optional[Callable[[int, str], None]] = None,
         telemetry=None,
+        indices: Optional[List[int]] = None,
     ):
         from raft_ncup_tpu.observability import get_telemetry
 
@@ -315,12 +316,33 @@ class ReplicaSupervisor:
         self._env = env
         self._on_death = on_death
         self._tel = telemetry if telemetry is not None else get_telemetry()
+        # ``indices``: the replica slots THIS supervisor owns — a host
+        # agent supervises only its host's placement, and the
+        # autoscaler grows/shrinks the set via add_replica /
+        # remove_replica. Default: the initial n_replicas.
         self.replicas: List[ReplicaHandle] = [
-            ReplicaHandle(cfg.replica(i)) for i in range(cfg.n_replicas)
+            ReplicaHandle(cfg.replica(i))
+            for i in (
+                range(cfg.n_replicas) if indices is None else indices
+            )
         ]
+        # Handles of replicas retired by remove_replica (scale-down):
+        # their counters/violations stay in report() — elasticity must
+        # not launder a replica's history by retiring it.
+        self.retired: List[ReplicaHandle] = []
         self._lock = threading.RLock()
         self._poll_stop = threading.Event()
         self._poll_thread: Optional[threading.Thread] = None
+
+    def handle(self, i: int) -> ReplicaHandle:
+        """The live handle for GLOBAL replica index ``i`` (handles are
+        keyed by slot index, not list position — a host agent's or an
+        elastically-scaled supervisor's list is sparse)."""
+        with self._lock:
+            for h in self.replicas:
+                if h.index == i:
+                    return h
+        raise KeyError(f"no live replica handle for index {i}")
 
     # ------------------------------------------------------------ spawning
 
@@ -365,10 +387,10 @@ class ReplicaSupervisor:
         deadline = time.monotonic() + (
             self.cfg.spawn_timeout_s if timeout is None else timeout
         )
-        pending = set(range(self.cfg.n_replicas))
+        pending = {h.index for h in self.replicas}
         while pending:
             for i in sorted(pending):
-                handle = self.replicas[i]
+                handle = self.handle(i)
                 child = handle.child
                 if child is not None and not child.running:
                     rc, out, err = child.reap(timeout=5.0)
@@ -515,6 +537,72 @@ class ReplicaSupervisor:
         if self._on_death is not None:
             self._on_death(handle.index, reason)
 
+    # -------------------------------------------------- elastic membership
+
+    def add_replica(
+        self, i: int, wait_ready: bool = False,
+        timeout: Optional[float] = None,
+    ) -> ReplicaHandle:
+        """Grow the supervised set by slot ``i`` (autoscaler scale-up /
+        a host agent's spawn command). The new replica starts SPAWNING
+        and is promoted to UP by the normal poll path once its healthz
+        reads ready — the pre-warm gate: the router's shape-aware
+        preference only ever sees it AFTER its warmed executable set is
+        advertised. ``wait_ready=True`` blocks (autoscalers don't —
+        they watch the handle across ticks)."""
+        with self._lock:
+            for h in self.replicas:
+                if h.index == i:
+                    raise ValueError(
+                        f"replica slot {i} already supervised "
+                        f"(state={h.state})"
+                    )
+            handle = ReplicaHandle(self.cfg.replica(i))
+            self.replicas.append(handle)
+            self._spawn(handle)
+        self._tel.event("fleet_scale_up_spawn", replica=i)
+        if wait_ready:
+            deadline = time.monotonic() + (
+                self.cfg.spawn_timeout_s if timeout is None else timeout
+            )
+            while handle.state == SPAWNING:
+                self._poll_one(handle, time.monotonic())
+                if handle.state != SPAWNING:
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"scale-up replica {i} not ready within "
+                        f"{self.cfg.spawn_timeout_s}s"
+                    )
+                time.sleep(self.cfg.poll_interval_s)
+        return handle
+
+    def remove_replica(self, i: int, drain: bool = True) -> dict:
+        """Shrink the supervised set by slot ``i`` (autoscaler
+        scale-down): graceful drain (SIGTERM → DRAINING → exit 75,
+        ZERO in-flight loss — the existing contract, reused, not
+        re-implemented), then retire the handle so the slot is free
+        for a future scale-up. The retired handle's counters stay in
+        :meth:`report`."""
+        handle = self.handle(i)
+        result = (
+            self.drain(i) if drain
+            else {"observed_draining": False, "returncode": None}
+        )
+        if not drain and handle.child is not None:
+            handle.child.kill()
+            handle.child.reap(timeout=10.0)
+            with self._lock:
+                handle.state = EXITED
+        with self._lock:
+            self.replicas = [h for h in self.replicas if h.index != i]
+            self.retired.append(handle)
+        self._tel.event(
+            "fleet_scale_down_retired", replica=i,
+            returncode=result.get("returncode"),
+        )
+        return result
+
     # ------------------------------------------------------ orchestration
 
     def drain(self, i: int, timeout: Optional[float] = None) -> dict:
@@ -522,7 +610,7 @@ class ReplicaSupervisor:
         ``draining: true`` in healthz ⇒ expect exit 75. Returns the
         contract observations + the replica's final report; violations
         are recorded on the handle, never swallowed."""
-        handle = self.replicas[i]
+        handle = self.handle(i)
         child = handle.child
         timeout = self.cfg.drain_timeout_s if timeout is None else timeout
         with self._lock:
@@ -580,7 +668,7 @@ class ReplicaSupervisor:
         flush. The death is detected and handled by the normal poll
         path — restart budget, circuit breaker, router failover all
         apply exactly as for an organic crash."""
-        handle = self.replicas[i]
+        handle = self.handle(i)
         self._tel.event("fleet_replica_kill", replica=i)
         if handle.child is not None:
             handle.child.kill()
@@ -592,12 +680,12 @@ class ReplicaSupervisor:
         lingers but stops heartbeating — detection rides the healthz
         staleness contract, not process liveness."""
         self._tel.event("fleet_replica_stall", replica=i)
-        handle = self.replicas[i]
+        handle = self.handle(i)
         if handle.child is not None:
             handle.child.suspend()
 
     def resume(self, i: int) -> None:
-        handle = self.replicas[i]
+        handle = self.handle(i)
         if handle.child is not None:
             handle.child.resume()
 
@@ -633,16 +721,23 @@ class ReplicaSupervisor:
         is only as honest as its bookkeeping)."""
         with self._lock:
             snaps = [h.snapshot() for h in self.replicas]
+            retired = [h.snapshot() for h in self.retired]
+        # Retired (scaled-down) replicas stay in the totals: elasticity
+        # must not launder history by retiring a handle.
+        everything = snaps + retired
         return {
             "replicas": snaps,
-            "deaths": sum(s["deaths"] for s in snaps),
-            "stale_deaths": sum(s["stale_deaths"] for s in snaps),
-            "restarts": sum(s["restarts"] for s in snaps),
+            "retired": retired,
+            "deaths": sum(s["deaths"] for s in everything),
+            "stale_deaths": sum(
+                s["stale_deaths"] for s in everything
+            ),
+            "restarts": sum(s["restarts"] for s in everything),
             "circuits_open": sum(
-                1 for s in snaps if s["circuit_open"]
+                1 for s in everything if s["circuit_open"]
             ),
             "contract_violations": [
-                v for s in snaps for v in s["contract_violations"]
+                v for s in everything for v in s["contract_violations"]
             ],
         }
 

@@ -107,16 +107,32 @@ class _Pending:
 class _Link:
     """One live socket to one replica incarnation, with its reader
     thread. Dead links are discarded; a restarted replica gets a fresh
-    link on the next dispatch."""
+    link on the next dispatch.
+
+    Half-open detection (TCP): when the socket carries a read deadline
+    (``wire.set_read_timeout``; the router arms it on INET links), a
+    deadline at a frame BOUNDARY (``wire.FrameTimeout``) means the link
+    is idle — or the peer vanished without a FIN and will never speak
+    again. The reader answers it with a ping probe: a healthy peer
+    pongs before the next deadline, a half-open one fails the send
+    (RST once the peer's host notices, or ``SO_SNDTIMEO`` when even
+    that is gone) and the normal down path flushes this incarnation's
+    in-flight requests — half-open detection folded into the existing
+    incarnation-tagged failover flush, not a second mechanism. A
+    deadline MID-frame arrives as ``ConnectionError`` (slow-loris /
+    dying peer) and tears the link like any torn frame."""
 
     def __init__(self, index: int, sock: socket.socket,
-                 on_message: Callable, on_down: Callable):
+                 on_message: Callable, on_down: Callable,
+                 clock: Callable[[], float] = time.monotonic):
         self.index = index
         self.sock = sock
         self.alive = True
         self.send_lock = threading.Lock()
         self._on_message = on_message
         self._on_down = on_down
+        self._clock = clock
+        self.probes = 0  # boundary-timeout ping probes sent
         self.reader = threading.Thread(
             target=self._read_loop, name=f"fleet-link-{index}", daemon=True
         )
@@ -136,7 +152,15 @@ class _Link:
     def _read_loop(self) -> None:
         try:
             while True:
-                msg = wire.recv_msg(self.sock)
+                try:
+                    msg = wire.recv_msg(self.sock)
+                except wire.FrameTimeout:
+                    self.probes += 1
+                    if not self.send(
+                        {"kind": "ping", "t0": self._clock()}
+                    ):
+                        break  # half-open: the send noticed first
+                    continue
                 if msg is None:
                     break
                 self._on_message(self.index, *msg)
@@ -172,12 +196,20 @@ class FleetRouter:
         self._lock = threading.RLock()
         self._links: Dict[int, _Link] = {}
         self._pending: Dict[int, _Pending] = {}
+        # Keyed by replica slot index; accessed with .get(i, 0) — the
+        # live set is elastic (autoscaler adds/retires slots), so a
+        # fresh slot must not KeyError its first dispatch.
         self._inflight: Dict[int, int] = {
             i: 0 for i in range(cfg.n_replicas)
         }
         self._dispatched: Dict[int, int] = {
             i: 0 for i in range(cfg.n_replicas)
         }
+        # The autoscaler's published time-to-READY estimate (None when
+        # capacity isn't warming): sheds while a scale-up is still
+        # compiling must tell the client to retry AFTER the new
+        # replica can admit, not the default 250ms re-shed treadmill.
+        self._scale_eta_s: Optional[float] = None
         self._affinity: Dict[str, int] = {}
         self._shed_hints: Dict[int, float] = {}
         self._replica_of: Dict[int, int] = {}  # rid -> last replica
@@ -222,7 +254,7 @@ class FleetRouter:
         """Does replica ``i`` advertise a warmed executable for this
         native shape? Matched on the padded (H, W) of the replica's own
         pad divisor against the healthz ``warmed`` set."""
-        handle = self.sup.replicas[i]
+        handle = self.sup.handle(i)
         hz = handle.last_healthz
         warmed = (hz or {}).get("warmed") or []
         ph, pw = self.cfg.shape_key(h, w, i)
@@ -264,7 +296,7 @@ class FleetRouter:
         # Admission bound: shed at the router before a socket hop.
         open_cap = [
             i for i in candidates
-            if self._inflight[i] < self.cfg.max_inflight_per_replica
+            if self._inflight.get(i, 0) < self.cfg.max_inflight_per_replica
         ]
         if not open_cap:
             return None, consulted
@@ -273,21 +305,28 @@ class FleetRouter:
         # still spreads over the fleet instead of pinning replica 0.
         return min(
             open_cap,
-            key=lambda i: (self._inflight[i], self._dispatched[i], i),
+            key=lambda i: (
+                self._inflight.get(i, 0), self._dispatched.get(i, 0), i,
+            ),
         ), consulted
 
     def _retry_after(self, consulted) -> float:
         """The aggregated backpressure hint: the MAX over the hints the
         consulted replicas last shed with (never smaller than any
-        replica the decision looked at), floored at the config
-        default."""
+        replica the decision looked at), floored at the config default
+        — and at the autoscaler's published time-to-READY estimate
+        while a scale-up is warming: a client told "retry in 250ms"
+        during a cold compile just re-sheds; a client told "retry in
+        the ETA" lands on the new capacity (regression-pinned in
+        tests/test_fleet.py)."""
         hints = [
             self._shed_hints[i] for i in consulted
             if i in self._shed_hints
         ]
-        return round(
-            max(hints + [self.cfg.default_retry_after_s]), 4
-        )
+        floor = [self.cfg.default_retry_after_s]
+        if self._scale_eta_s is not None:
+            floor.append(self._scale_eta_s)
+        return round(max(hints + floor), 4)
 
     def _link(self, i: int) -> Optional[_Link]:
         with self._lock:
@@ -296,8 +335,12 @@ class FleetRouter:
                 return link
         spec = self.cfg.replica(i)
         try:
-            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            sock.connect(spec.socket_path)
+            transport = wire.Transport.parse(
+                spec.address or spec.socket_path
+            )
+            sock = transport.connect(
+                timeout_s=self.cfg.connect_timeout_s
+            )
             # Bound SENDS only (SO_SNDTIMEO, not settimeout: the reader
             # thread shares this socket and must block indefinitely): a
             # frame pair can exceed the UDS buffer, and sendall to a
@@ -309,9 +352,19 @@ class FleetRouter:
                 socket.SOL_SOCKET, socket.SO_SNDTIMEO,
                 _struct.pack("ll", 10, 0),
             )
-        except OSError:
+            if transport.is_inet:
+                # Read deadline → boundary timeouts → the link reader's
+                # ping probe: half-open peers (partitioned host, agent
+                # SIGKILL) get flushed instead of hanging forever.
+                wire.set_read_timeout(
+                    sock, self.cfg.link_read_timeout_s
+                )
+        except (OSError, ValueError):
             return None
-        link = _Link(i, sock, self._on_message, self._on_link_down)
+        link = _Link(
+            i, sock, self._on_message, self._on_link_down,
+            clock=self._clock,
+        )
         with self._lock:
             self._links[i] = link
         # Clock handshake: ping carries the router's monotonic clock;
@@ -384,8 +437,8 @@ class FleetRouter:
 
     def _register(self, pending: _Pending, target: int) -> None:
         self._pending[pending.rid] = pending
-        self._inflight[target] += 1
-        self._dispatched[target] += 1
+        self._inflight[target] = self._inflight.get(target, 0) + 1
+        self._dispatched[target] = self._dispatched.get(target, 0) + 1
         self._replica_of[pending.rid] = target
         self.stats["routed"] += 1
 
@@ -466,12 +519,26 @@ class FleetRouter:
             pending = self._pending.pop(rid, None)
             if pending is not None:
                 self._inflight[pending.replica] = max(
-                    0, self._inflight[pending.replica] - 1
+                    0, self._inflight.get(pending.replica, 0) - 1
                 )
         if pending is None:
             return  # failed over already; the late answer is dropped
         status = header.get("status", STATUS_ERROR)
         retry_after = header.get("retry_after_s")
+        if status == STATUS_SHED and header.get("detail") == "draining":
+            # A draining replica refuses work it never admitted into
+            # its engine (the SIGTERM beat the socket read). That
+            # refusal is re-routable — the scale-down zero-loss claim
+            # is the ROUTER's to keep — so treat it like a death-
+            # stranding: redispatch to a survivor within the failover
+            # budget (and shed honestly, ETA-floored, only if none can
+            # admit).
+            self._tel.event(
+                "fleet_drain_refusal_failover", request_id=rid,
+                replica=index,
+            )
+            self._failover_one(pending, index, self._clock())
+            return
         if status == STATUS_SHED:
             # Aggregate the backpressure hint: never smaller than any
             # replica this request's routing consulted.
@@ -571,7 +638,7 @@ class FleetRouter:
                 # Only this incarnation's requests died; a racing fresh
                 # link may already carry live ones.
                 self._inflight[index] = max(
-                    0, self._inflight[index] - len(stranded)
+                    0, self._inflight.get(index, 0) - len(stranded)
                 )
             # Streams homed here must re-admit elsewhere, cold (a
             # reconnected incarnation has no warm slot state either).
@@ -654,8 +721,8 @@ class FleetRouter:
 
     def _register_failover(self, pending: _Pending, target: int) -> None:
         self._pending[pending.rid] = pending
-        self._inflight[target] += 1
-        self._dispatched[target] += 1
+        self._inflight[target] = self._inflight.get(target, 0) + 1
+        self._dispatched[target] = self._dispatched.get(target, 0) + 1
         self._replica_of[pending.rid] = target
 
     # ------------------------------------------------------------ queries
@@ -708,6 +775,44 @@ class FleetRouter:
     def pending_count(self) -> int:
         with self._lock:
             return len(self._pending)
+
+    # --------------------------------------------- autoscaler surfaces
+
+    def inflight_of(self, i: int) -> int:
+        """Outstanding dispatches on replica ``i`` — the autoscaler's
+        per-replica occupancy input and its least-loaded-victim key on
+        scale-down."""
+        with self._lock:
+            return self._inflight.get(i, 0)
+
+    def queue_depth(self) -> int:
+        """Total dispatched-but-unanswered requests (the router has no
+        literal queue — backpressure sheds at admission — so depth IS
+        the fleet-wide in-flight count)."""
+        with self._lock:
+            return sum(self._inflight.values())
+
+    def occupancy(self) -> float:
+        """Fleet-wide occupancy in [0, 1]: in-flight over the open
+        capacity of the admittable set. 1.0 with NOTHING admittable —
+        a fleet with no admittable replica is saturated by definition,
+        not idle."""
+        with self._lock:
+            admittable = self._admittable()
+            cap = len(admittable) * self.cfg.max_inflight_per_replica
+            if cap <= 0:
+                return 1.0
+            used = sum(self._inflight.get(i, 0) for i in admittable)
+            return min(1.0, used / cap)
+
+    def set_scale_eta(self, eta_s: Optional[float]) -> None:
+        """Publish (or clear, with ``None``) the autoscaler's
+        time-to-READY estimate: every shed's ``retry_after_s`` is
+        floored at it while set (see :meth:`_retry_after`)."""
+        with self._lock:
+            self._scale_eta_s = (
+                None if eta_s is None else max(0.0, float(eta_s))
+            )
 
     def report(self) -> dict:
         with self._lock:
@@ -775,12 +880,22 @@ def replay_fleet(
     supervisor: Optional[ReplicaSupervisor] = None,
     chaos=None,
     interval_s: float = 0.0,
+    manager=None,
 ):
     """Drive a deterministic schedule through the router, firing fleet
     chaos at exact submission indices (the PR 5/6 machinery at fleet
     granularity): after submission ``n`` dispatches, ``killreplica@n``
     SIGKILLs / ``stallreplica@n`` SIGSTOPs / ``drainreplica@n`` SIGTERM-
     drains the replica that carried it. Returns the submission handles.
+
+    Host-scale kinds need ``manager`` (a
+    ``fleet/host_supervisor.FleetManager``): ``partitionhost@n`` drops
+    the TCP links to the host that carried submission ``n`` (both
+    directions), ``killsupervisor@n`` SIGKILLs that host's agent (its
+    replicas linger until the staleness contract reaps them). The
+    coordinate stays a submission index for every kind — the TARGET
+    host is derived from the carrying replica's placement, so the
+    blast lands deterministically.
 
     ``items``: dicts with ``image1``/``image2`` (+ optional
     ``stream_id``, ``frame_index``, ``deadline_s``).
@@ -796,9 +911,9 @@ def replay_fleet(
             frame_index=item.get("frame_index"),
         )
         handles.append(handle)
-        if chaos is not None and supervisor is not None:
+        if chaos is not None:
             target = router.replica_of(rid)
-            if target is not None:
+            if target is not None and supervisor is not None:
                 if n in chaos.kill_replica_at:
                     supervisor.kill(target)
                 if n in chaos.stall_replica_at:
@@ -808,6 +923,11 @@ def replay_fleet(
                         target=supervisor.drain, args=(target,),
                         name=f"chaos-drain-{target}", daemon=True,
                     ).start()
+            if target is not None and manager is not None:
+                if n in chaos.partition_host_at:
+                    manager.partition(manager.host_of(target))
+                if n in chaos.kill_supervisor_at:
+                    manager.kill_agent(manager.host_of(target))
         if interval_s:
             time.sleep(interval_s)
     return handles

@@ -43,8 +43,10 @@ def padded_shape(
 @dataclass(frozen=True)
 class ReplicaSpec:
     """One replica's addresses, derived from :class:`FleetConfig` —
-    where its Unix socket listens, where it rewrites its healthz file,
-    and where its flight recorder banks fault dumps."""
+    where its wire endpoint listens (``address``: a UDS path or
+    ``host:port``, the string ``fleet/wire.Transport.parse`` decides
+    the family from), where it rewrites its healthz file, and where its
+    flight recorder banks fault dumps."""
 
     index: int
     socket_path: str
@@ -55,6 +57,14 @@ class ReplicaSpec:
     # fleet-wide registry view.
     telemetry_jsonl: str = ""
     mesh: Optional[Tuple[int, int]] = None
+    # The wire address (serve.py --replica_socket): equals socket_path
+    # under the UDS transport, "host:port" under TCP. Empty only when a
+    # spec is constructed by hand without one (tests) — cfg-derived
+    # specs always fill it.
+    address: str = ""
+    # The named host this replica is placed on ("" = the single
+    # implicit local host of a UDS fleet).
+    host: str = ""
 
 
 @dataclass(frozen=True)
@@ -117,6 +127,59 @@ class FleetConfig:
     # no traffic — a crash-looping replica must stop eating requests.
     circuit_break_after: int = 3
 
+    # --- transport + host placement -------------------------------------
+    # "unix": every replica listens on a UDS path under base_dir (one
+    # host, the PR 13 topology). "tcp": replica i listens on
+    # tcp_host:(base_port + i) — the socket-family swap wire.py was
+    # designed for; healthz/flight PATHS stay per-host-local and travel
+    # to remote consumers via the HostSupervisor's wire republish.
+    transport: str = "unix"
+    tcp_host: str = "127.0.0.1"
+    base_port: int = 0  # required > 0 under tcp; replica i = base + i
+    # Named hosts and the per-replica placement over them. () = one
+    # implicit host (every replica host ""). When given, placement maps
+    # every replica slot 0..scale_max-1 to a host name (None =
+    # round-robin over hosts); each host gets a HostSupervisor agent
+    # that spawns/reaps its replicas and republishes their healthz over
+    # the wire at host_control_address(host).
+    hosts: Tuple[str, ...] = ()
+    placement: Optional[Tuple[str, ...]] = None
+
+    # --- elastic sizing (fleet/autoscaler.py) ---------------------------
+    # n_replicas is the INITIAL size; the autoscaler moves the live
+    # count inside [scale_min, scale_max] (None = pinned at n_replicas,
+    # the PR 13 fixed-N behavior). Addresses/meshes are declared for
+    # every slot up to scale_max — capacity is topology, not a runtime
+    # discovery.
+    min_replicas: Optional[int] = None
+    max_replicas: Optional[int] = None
+    # Decision cadence + anti-flap: a scale decision needs the same
+    # signal for scale_hysteresis_ticks consecutive ticks AND
+    # scale_cooldown_s since the last topology change — an oscillating
+    # signal whose period beats either bound cannot thrash the fleet.
+    scale_tick_s: float = 1.0
+    scale_cooldown_s: float = 10.0
+    scale_hysteresis_ticks: int = 3
+    # Occupancy (fleet-wide inflight / open capacity) thresholds.
+    scale_up_occupancy: float = 0.8
+    scale_down_occupancy: float = 0.25
+    # Consecutive FAILED scale-ups (spawned replica dies/breaks before
+    # READY) that open the autoscaler's own breaker: no further
+    # scale-ups — a respawn storm must be bounded at the control loop
+    # too, not only per replica.
+    scale_fail_budget: int = 2
+    # Prior for the time-to-READY estimate (seconds) before any
+    # scale-up has been observed — what shed retry_after_s hints are
+    # floored at while capacity is still warming.
+    scale_eta_prior_s: float = 20.0
+
+    # --- TCP wire hardening ---------------------------------------------
+    connect_timeout_s: float = 10.0
+    # Router link read deadline (TCP only): silence past this triggers
+    # the link reader's ping probe — half-open detection (peer vanished
+    # without FIN) folded into the normal link-down failover flush.
+    link_read_timeout_s: float = 30.0
+
     def __post_init__(self) -> None:
         if self.n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1: {self.n_replicas}")
@@ -126,12 +189,70 @@ class FleetConfig:
         if int(h) < 16 or int(w) < 16:
             raise ValueError(f"size_hw too small for the pyramid: {self.size_hw}")
         if self.meshes is not None:
-            if len(self.meshes) != self.n_replicas:
+            if len(self.meshes) != self.scale_max:
                 raise ValueError(
                     f"meshes has {len(self.meshes)} entries for "
-                    f"{self.n_replicas} replicas — the topology object "
-                    "must name every replica's mesh slice explicitly"
+                    f"{self.scale_max} replica slots — the topology "
+                    "object must name every slot's mesh slice "
+                    "explicitly (scale_max slots, not just the initial "
+                    "n_replicas)"
                 )
+        if self.transport not in ("unix", "tcp"):
+            raise ValueError(
+                f"transport must be 'unix' or 'tcp': {self.transport!r}"
+            )
+        if self.transport == "tcp" and self.base_port <= 0:
+            raise ValueError(
+                "tcp transport needs base_port > 0 (replica i listens "
+                "on tcp_host:(base_port + i); ports are topology)"
+            )
+        if self.placement is not None:
+            if not self.hosts:
+                raise ValueError("placement given without named hosts")
+            if len(self.placement) != self.scale_max:
+                raise ValueError(
+                    f"placement has {len(self.placement)} entries for "
+                    f"{self.scale_max} replica slots"
+                )
+            unknown = sorted(set(self.placement) - set(self.hosts))
+            if unknown:
+                raise ValueError(
+                    f"placement names unknown hosts {unknown} "
+                    f"(hosts={list(self.hosts)})"
+                )
+        if not (
+            self.scale_min <= self.n_replicas <= self.scale_max
+        ) or self.scale_min < 1:
+            raise ValueError(
+                f"replica bounds must satisfy 1 <= min_replicas "
+                f"({self.scale_min}) <= n_replicas ({self.n_replicas}) "
+                f"<= max_replicas ({self.scale_max})"
+            )
+        if not (
+            0.0 < self.scale_down_occupancy < self.scale_up_occupancy
+            <= 1.0
+        ):
+            raise ValueError(
+                "occupancy thresholds must satisfy 0 < "
+                f"scale_down_occupancy ({self.scale_down_occupancy}) < "
+                f"scale_up_occupancy ({self.scale_up_occupancy}) <= 1 "
+                "— an inverted band would flap by construction"
+            )
+        if self.scale_hysteresis_ticks < 1:
+            raise ValueError(
+                f"scale_hysteresis_ticks must be >= 1: "
+                f"{self.scale_hysteresis_ticks}"
+            )
+        if self.scale_fail_budget < 1:
+            raise ValueError(
+                f"scale_fail_budget must be >= 1: {self.scale_fail_budget}"
+            )
+        for name in (
+            "scale_tick_s", "scale_cooldown_s", "scale_eta_prior_s",
+            "connect_timeout_s", "link_read_timeout_s",
+        ):
+            if getattr(self, name) <= 0:
+                raise ValueError(f"{name} must be > 0: {getattr(self, name)}")
         for name in (
             "snapshot_interval_s", "poll_interval_s", "spawn_timeout_s",
             "drain_timeout_s", "restart_backoff_s", "restart_backoff_max_s",
@@ -164,9 +285,66 @@ class FleetConfig:
         presumed dead even if the process lingers."""
         return self.snapshot_interval_s * self.stale_after_factor
 
+    @property
+    def scale_min(self) -> int:
+        """Autoscaler floor (``min_replicas``, default: pinned at
+        ``n_replicas``)."""
+        return (
+            self.n_replicas if self.min_replicas is None
+            else self.min_replicas
+        )
+
+    @property
+    def scale_max(self) -> int:
+        """Autoscaler ceiling AND the number of declared replica slots
+        (addresses, meshes, placement all cover ``scale_max``)."""
+        return (
+            self.n_replicas if self.max_replicas is None
+            else self.max_replicas
+        )
+
+    def host_of(self, i: int) -> str:
+        """The named host replica slot ``i`` is placed on ("" for the
+        single implicit host of an unplaced fleet). Default placement
+        is round-robin over ``hosts``."""
+        if not self.hosts:
+            return ""
+        if self.placement is not None:
+            return self.placement[i]
+        return self.hosts[i % len(self.hosts)]
+
+    def replicas_on(self, host: str) -> list:
+        """Replica slot indices placed on ``host`` (all scale_max
+        slots, live or not — slots are topology)."""
+        return [
+            i for i in range(self.scale_max) if self.host_of(i) == host
+        ]
+
+    def replica_address(self, i: int) -> str:
+        """Replica ``i``'s wire address — the one string both ends
+        parse the socket family from (``wire.Transport.parse``)."""
+        if self.transport == "tcp":
+            return f"{self.tcp_host}:{self.base_port + i}"
+        return os.path.join(self.base_dir, f"replica_{i}.sock")
+
+    def host_control_address(self, host: str) -> str:
+        """Where ``host``'s HostSupervisor agent listens for control
+        frames (healthz republish, spawn/drain commands). TCP ports
+        for agents sit directly above the replica-slot ports."""
+        if self.transport == "tcp":
+            hosts = self.hosts or ("",)
+            return (
+                f"{self.tcp_host}:"
+                f"{self.base_port + self.scale_max + hosts.index(host)}"
+            )
+        tag = host or "local"
+        return os.path.join(self.base_dir, f"host_{tag}.sock")
+
     def replica(self, i: int) -> ReplicaSpec:
-        if not 0 <= i < self.n_replicas:
-            raise ValueError(f"replica {i} out of range 0..{self.n_replicas - 1}")
+        if not 0 <= i < self.scale_max:
+            raise ValueError(
+                f"replica {i} out of range 0..{self.scale_max - 1}"
+            )
         return ReplicaSpec(
             index=i,
             socket_path=os.path.join(self.base_dir, f"replica_{i}.sock"),
@@ -178,10 +356,47 @@ class FleetConfig:
                 self.base_dir, f"replica_{i}_telemetry.jsonl"
             ),
             mesh=None if self.meshes is None else self.meshes[i],
+            address=self.replica_address(i),
+            host=self.host_of(i),
         )
 
     def replicas(self) -> list:
         return [self.replica(i) for i in range(self.n_replicas)]
+
+    def host_manifest(self, host: str) -> dict:
+        """The JSON-able slice of this topology one HostSupervisor
+        agent needs: every replica slot placed on ``host`` (its argv,
+        addresses, and whether it starts immediately or is a scale-up
+        slot), plus the supervision policy — so the agent process
+        reconstructs ONLY what it supervises, never the whole fleet
+        (``fleet/host_supervisor.ManifestConfig`` adapts it back for
+        the unmodified ReplicaSupervisor)."""
+        return {
+            "host": host,
+            "control": self.host_control_address(host),
+            "base_dir": self.base_dir,
+            "poll_interval_s": self.poll_interval_s,
+            "spawn_timeout_s": self.spawn_timeout_s,
+            "drain_timeout_s": self.drain_timeout_s,
+            "snapshot_interval_s": self.snapshot_interval_s,
+            "stale_after_s": self.stale_after_s,
+            "max_restarts": self.max_restarts,
+            "restart_backoff_s": self.restart_backoff_s,
+            "restart_backoff_max_s": self.restart_backoff_max_s,
+            "circuit_break_after": self.circuit_break_after,
+            "replicas": [
+                {
+                    "index": i,
+                    "start": i < self.n_replicas,
+                    "address": self.replica_address(i),
+                    "socket_path": self.replica(i).socket_path,
+                    "healthz_path": self.replica(i).healthz_path,
+                    "flight_dir": self.replica(i).flight_dir,
+                    "argv": self.replica_argv(i),
+                }
+                for i in self.replicas_on(host)
+            ],
+        }
 
     def pad_divisor(self, i: int) -> int:
         """Replica ``i``'s pad divisor (8 * spatial under a mesh)."""
@@ -205,7 +420,7 @@ class FleetConfig:
         spec = self.replica(i)
         s, st = self.serve, self.stream
         argv = [
-            "--replica_socket", spec.socket_path,
+            "--replica_socket", spec.address,
             "--replica_index", str(i),
             "--healthz_file", spec.healthz_path,
             "--flight_dir", spec.flight_dir,

@@ -1,6 +1,6 @@
 """Socket frame protocol between the router and a replica server:
 length-prefixed JSON header + raw C-order ndarray payloads over a Unix
-domain socket (docs/FLEET.md "Wire format").
+domain socket or a TCP connection (docs/FLEET.md "Wire format").
 
 One frame is::
 
@@ -23,6 +23,28 @@ old router's (``TraceContext.from_wire(header.get(TRACE_KEY))`` is
 ``None``); consumers therefore read it with ``.get``, never a
 subscript — lint rule JGL010 checks that statically for ``fleet/``.
 
+**Addressing** (:class:`Transport`): an address string is either a
+filesystem path (Unix domain socket — anything containing a path
+separator, or lacking a ``host:port`` shape) or ``host:port`` (TCP).
+The frame protocol is family-agnostic; what the INET family adds is
+failure modes the LAN owns and the loopback never shows:
+
+- a connect can hang on an unroutable host → :meth:`Transport.connect`
+  bounds it with a timeout;
+- a peer can vanish without a FIN (host partition, agent SIGKILL) and
+  leave the connection half-open — ``SO_KEEPALIVE`` is armed on every
+  TCP socket, and a read deadline (:func:`set_read_timeout`, raw
+  ``SO_RCVTIMEO`` so sends stay governed by their own ``SO_SNDTIMEO``)
+  turns eternal silence into a timeout the caller can probe on;
+- a slow-loris peer can dribble a frame forever — a read timeout that
+  fires MID-frame raises ``ConnectionError`` (the frame can never be
+  trusted; same contract as a mid-frame EOF), while one that fires at a
+  frame BOUNDARY raises :class:`FrameTimeout` (the link is merely
+  idle; the router's link reader answers it with a ping probe).
+
+Clean-EOF vs mid-frame semantics are identical across families and
+pinned for both in tests/test_fleet.py.
+
 Host-only stdlib + numpy (JGL010 covers ``fleet/``): the wire layer
 must never be able to touch a device array — producers hand it host
 ndarrays that were pulled at their own sanctioned boundaries.
@@ -30,7 +52,9 @@ ndarrays that were pulled at their own sanctioned boundaries.
 
 from __future__ import annotations
 
+import errno
 import json
+import os
 import socket
 import struct
 from typing import List, Optional, Sequence, Tuple
@@ -47,6 +71,135 @@ MAX_HEADER_BYTES = 1 << 20
 TRACE_KEY = "trace"
 
 _LEN = struct.Struct(">I")
+
+# Default bound on a TCP connect (an unroutable host must fail in
+# seconds, not kernel-default minutes); FleetConfig overrides per fleet.
+DEFAULT_CONNECT_TIMEOUT_S = 10.0
+
+
+class FrameTimeout(TimeoutError):
+    """A read deadline fired at a frame BOUNDARY: the peer simply has
+    nothing to say (or is half-open — the caller cannot tell yet, which
+    is exactly why the router's link reader answers this with a ping
+    probe: a half-open peer fails the send and the normal down path
+    flushes). A deadline that fires MID-frame is ``ConnectionError``
+    instead — that frame can never be trusted."""
+
+
+class Transport:
+    """One parsed wire address: where a replica (or host agent)
+    listens, family included. ``host:port`` (port all digits, no path
+    separator) is TCP; anything else is a Unix-domain-socket path.
+
+    The parse is deliberately syntactic — the same string that appears
+    in ``FleetConfig``-derived argv (``serve.py --replica_socket``)
+    decides the family on both ends, so a topology is moved from UDS to
+    TCP by changing addresses, nothing else.
+    """
+
+    __slots__ = ("family", "path", "host", "port")
+
+    def __init__(self, family: int, path: str = "",
+                 host: str = "", port: int = 0):
+        self.family = family
+        self.path = path
+        self.host = host
+        self.port = port
+
+    @classmethod
+    def parse(cls, address: str) -> "Transport":
+        if not address:
+            raise ValueError("empty wire address")
+        host, sep, port = address.rpartition(":")
+        if sep and host and port.isdigit() and os.sep not in address:
+            return cls(socket.AF_INET, host=host, port=int(port))
+        return cls(socket.AF_UNIX, path=address)
+
+    @property
+    def is_inet(self) -> bool:
+        return self.family == socket.AF_INET
+
+    def render(self) -> str:
+        return f"{self.host}:{self.port}" if self.is_inet else self.path
+
+    def connect(
+        self, timeout_s: Optional[float] = DEFAULT_CONNECT_TIMEOUT_S,
+    ) -> socket.socket:
+        """Open a connected stream socket to this address. The connect
+        itself is bounded by ``timeout_s``; the returned socket is back
+        in blocking mode (read deadlines are the caller's policy —
+        :func:`set_read_timeout`). TCP sockets get ``SO_KEEPALIVE`` +
+        ``TCP_NODELAY`` (frames are latency-bound request/response
+        pairs, never throughput-bound streams worth Nagle-batching)."""
+        sock = socket.socket(self.family, socket.SOCK_STREAM)
+        try:
+            sock.settimeout(timeout_s)
+            if self.is_inet:
+                sock.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1
+                )
+                sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+                sock.connect((self.host, self.port))
+            else:
+                sock.connect(self.path)
+            sock.settimeout(None)
+        except BaseException:
+            sock.close()
+            raise
+        return sock
+
+    def listen(self, backlog: int = 16) -> socket.socket:
+        """Bind + listen on this address. A stale UDS path from a dead
+        incarnation is removed first; TCP binds with ``SO_REUSEADDR``
+        so a restarted replica is not locked out by its predecessor's
+        TIME_WAIT."""
+        sock = socket.socket(self.family, socket.SOCK_STREAM)
+        try:
+            if self.is_inet:
+                sock.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+                )
+                sock.bind((self.host, self.port))
+            else:
+                try:
+                    os.remove(self.path)
+                except OSError:
+                    pass
+                sock.bind(self.path)
+            sock.listen(backlog)
+        except BaseException:
+            sock.close()
+            raise
+        return sock
+
+    def cleanup(self) -> None:
+        """Remove the UDS path at teardown (no-op for TCP)."""
+        if not self.is_inet:
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass
+
+
+def set_read_timeout(
+    sock: socket.socket, timeout_s: Optional[float],
+) -> None:
+    """Arm a receive deadline as raw ``SO_RCVTIMEO`` — NOT
+    ``settimeout()``, which would flip the fd non-blocking and bound
+    sends too; the router's links already bound sends separately with
+    ``SO_SNDTIMEO`` and share one socket between a sender and a reader
+    thread. A deadline that fires surfaces in :func:`recv_msg` as
+    :class:`FrameTimeout` (frame boundary) or ``ConnectionError``
+    (mid-frame)."""
+    t = 0.0 if timeout_s is None else max(0.0, float(timeout_s))
+    sec = int(t)
+    usec = int(round((t - sec) * 1e6))
+    sock.setsockopt(
+        socket.SOL_SOCKET, socket.SO_RCVTIMEO,
+        struct.pack("ll", sec, usec),
+    )
 
 
 def send_msg(sock: socket.socket, header: dict,
@@ -75,11 +228,29 @@ def send_msg(sock: socket.socket, header: dict,
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     """Read exactly ``n`` bytes; None on clean EOF at a frame boundary
     (0 bytes read). A mid-frame EOF raises — a half message means the
-    peer died mid-send and the frame must not be trusted."""
+    peer died mid-send and the frame must not be trusted. A read
+    deadline (``settimeout`` or raw ``SO_RCVTIMEO``) that fires at 0
+    bytes raises :class:`FrameTimeout` (idle link, probe-able); one
+    that fires mid-read raises ``ConnectionError`` (slow-loris or
+    half-open peer — the frame is as dead as a torn one)."""
     chunks = []
     got = 0
     while got < n:
-        chunk = sock.recv(min(n - got, 1 << 20))
+        try:
+            chunk = sock.recv(min(n - got, 1 << 20))
+        except OSError as e:
+            if isinstance(e, socket.timeout) or e.errno in (
+                errno.EAGAIN, errno.EWOULDBLOCK,
+            ):
+                if got == 0:
+                    raise FrameTimeout(
+                        "no bytes within the read deadline"
+                    ) from e
+                raise ConnectionError(
+                    f"read deadline mid-frame ({got}/{n} bytes): "
+                    "slow-loris or half-open peer"
+                ) from e
+            raise
         if not chunk:
             if got == 0:
                 return None
@@ -103,20 +274,32 @@ def recv_msg(
     (n,) = _LEN.unpack(raw_len)
     if n > MAX_HEADER_BYTES:
         raise ValueError(f"frame header length {n} exceeds bound")
-    blob = _recv_exact(sock, n)
-    if blob is None:
-        raise ConnectionError("peer closed between length and header")
-    header = json.loads(blob.decode("utf-8"))
-    descs = header.pop("arrays", [])
-    arrays: List[np.ndarray] = []
-    for d in descs:
-        dtype = np.dtype(d["dtype"])
-        shape = tuple(int(x) for x in d["shape"])
-        count = 1
-        for x in shape:
-            count *= x
-        payload = _recv_exact(sock, count * dtype.itemsize)
-        if payload is None:
-            raise ConnectionError("peer closed before array payload")
-        arrays.append(np.frombuffer(payload, dtype=dtype).reshape(shape))
+    try:
+        blob = _recv_exact(sock, n)
+        if blob is None:
+            raise ConnectionError(
+                "peer closed between length and header"
+            )
+        header = json.loads(blob.decode("utf-8"))
+        descs = header.pop("arrays", [])
+        arrays: List[np.ndarray] = []
+        for d in descs:
+            dtype = np.dtype(d["dtype"])
+            shape = tuple(int(x) for x in d["shape"])
+            count = 1
+            for x in shape:
+                count *= x
+            payload = _recv_exact(sock, count * dtype.itemsize)
+            if payload is None:
+                raise ConnectionError("peer closed before array payload")
+            arrays.append(
+                np.frombuffer(payload, dtype=dtype).reshape(shape)
+            )
+    except FrameTimeout as e:
+        # The length prefix landed, so the frame has STARTED: a read
+        # deadline anywhere past it is mid-frame by definition, even if
+        # an individual _recv_exact saw 0 of its own bytes.
+        raise ConnectionError(
+            f"read deadline mid-frame (after length prefix): {e}"
+        ) from e
     return header, arrays

@@ -72,6 +72,23 @@ submission — deterministic because routing is):
   in-flight losses, child exits 75, and the router routes nothing new
   there from the moment it observes DRAINING.
 
+Fleet-scale (multi-host) events — the coordinate is still a submission
+index; the TARGET is the HOST of the replica that carried it (derived
+through the FleetConfig placement, so the blast is deterministic).
+Both need a ``fleet/host_supervisor.FleetManager``:
+
+- ``partitionhost@N`` — the TCP links to submission ``N``'s host drop,
+  both directions (the manager stops hearing the host's agent AND the
+  router's links there are torn) → the staleness contract declares the
+  whole host dead, in-flight work fails over, and the partitioned
+  replicas are fenced so they cannot answer after the failover.
+- ``killsupervisor@N`` — submission ``N``'s host AGENT is SIGKILLed;
+  its replica processes linger (orphans, still heartbeating their
+  local files) → the wire republish stops, the fleet-level staleness
+  contract declares the host dead, and the lingering replicas are
+  reaped (SIGKILL) before failover completes — zombies must never
+  answer a request the router already re-dispatched.
+
 NaN injection wraps the *host batch stream* (order-preserving, so batch
 ``i`` of the stream is exactly the batch step ``start_step + i``
 consumes, prefetch depth notwithstanding); the SIGTERM trigger lives in
@@ -90,7 +107,8 @@ import numpy as np
 ENV_VAR = "RAFT_NCUP_CHAOS"
 
 _KINDS = ("nan", "ioerror", "sigterm", "burst", "poison", "corruptframe",
-          "abandon", "killreplica", "stallreplica", "drainreplica")
+          "abandon", "killreplica", "stallreplica", "drainreplica",
+          "partitionhost", "killsupervisor")
 
 
 @dataclass(frozen=True)
@@ -107,6 +125,8 @@ class ChaosSpec:
     kill_replica_at: frozenset = frozenset()
     stall_replica_at: frozenset = frozenset()
     drain_replica_at: frozenset = frozenset()
+    partition_host_at: frozenset = frozenset()
+    kill_supervisor_at: frozenset = frozenset()
 
     @classmethod
     def parse(cls, spec: Optional[str]) -> "ChaosSpec":
@@ -138,6 +158,8 @@ class ChaosSpec:
             frozenset(sets["killreplica"]),
             frozenset(sets["stallreplica"]),
             frozenset(sets["drainreplica"]),
+            frozenset(sets["partitionhost"]),
+            frozenset(sets["killsupervisor"]),
         )
 
     @property
@@ -146,7 +168,8 @@ class ChaosSpec:
                     or self.burst_requests or self.poison_requests
                     or self.corrupt_frames or self.abandon_frames
                     or self.kill_replica_at or self.stall_replica_at
-                    or self.drain_replica_at
+                    or self.drain_replica_at or self.partition_host_at
+                    or self.kill_supervisor_at
                     or self.sigterm_after is not None)
 
     def render(self) -> str:
@@ -159,6 +182,12 @@ class ChaosSpec:
         parts += [f"killreplica@{n}" for n in sorted(self.kill_replica_at)]
         parts += [f"stallreplica@{n}" for n in sorted(self.stall_replica_at)]
         parts += [f"drainreplica@{n}" for n in sorted(self.drain_replica_at)]
+        parts += [
+            f"partitionhost@{n}" for n in sorted(self.partition_host_at)
+        ]
+        parts += [
+            f"killsupervisor@{n}" for n in sorted(self.kill_supervisor_at)
+        ]
         if self.sigterm_after is not None:
             parts.append(f"sigterm@{self.sigterm_after}")
         return ",".join(parts) or "<none>"

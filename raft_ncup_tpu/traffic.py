@@ -1,0 +1,195 @@
+"""Deterministic multi-phase traffic: the scenario layer over the
+single-rate streams (first slice of ROADMAP item 4's scenario suite;
+docs/FLEET.md "Elasticity bench").
+
+``serving/traffic.SyntheticTraffic`` is one arrival rate for the whole
+run — right for chaos coordinates, wrong for the questions elasticity
+asks, which are all about rate CHANGES: how long after a load step does
+new capacity take traffic, does a scale-down under load lose anything,
+does p99 stay flat through both. :class:`StepTraffic` strings
+:class:`TrafficPhase` segments (each its own inter-arrival interval)
+into one schedule whose due times, frame content, and phase attribution
+are all pure functions of ``(seed, phases)`` — the same step replays
+bitwise-identically into the serve bench, the fleet bench, and the
+autoscaler acceptance tests.
+
+Three consumption shapes, one schedule:
+
+- ``iter(traffic)`` yields ``(due_s, image1, image2)`` — drop-in for
+  ``serving/traffic.replay`` (the serve.py driver);
+- :meth:`items` yields ``fleet/router.replay_fleet`` dicts
+  (``image1``/``image2`` + ``due_s``/``phase`` riders);
+- :meth:`schedule` yields the rich records (global index, phase name,
+  due time, frames) the elasticity bench attributes latencies with.
+
+Chaos composes exactly as it does for the single-rate stream:
+``burst@N`` expands request ``N`` into ``burst_size`` simultaneous
+arrivals, ``poison@N`` NaNs request ``N``'s first frame — ``N`` is the
+global request index across phases, so fault coordinates stay
+deterministic through a rate step.
+
+Generation is pure numpy on the submitting thread (frames come from
+``data/synthetic``, same as the single-rate stream — bench drivers
+already hold that import; the jax-free router PROCESS never generates
+traffic, it only receives it).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from raft_ncup_tpu.data.synthetic import SyntheticFlowDataset
+from raft_ncup_tpu.resilience.chaos import ChaosSpec
+
+__all__ = ["TrafficPhase", "StepTraffic", "TrafficItem"]
+
+
+@dataclass(frozen=True)
+class TrafficPhase:
+    """One constant-rate segment of a schedule. ``interval_s`` is the
+    inter-arrival gap inside the phase (0 = as fast as the driver
+    submits)."""
+
+    name: str
+    n_requests: int
+    interval_s: float
+
+    def __post_init__(self) -> None:
+        if self.n_requests < 0:
+            raise ValueError(f"n_requests must be >= 0: {self.n_requests}")
+        if self.interval_s < 0:
+            raise ValueError(f"interval_s must be >= 0: {self.interval_s}")
+
+
+@dataclass(frozen=True)
+class TrafficItem:
+    """One scheduled arrival, fully attributed."""
+
+    index: int          # global request index (the chaos coordinate)
+    phase: str
+    due_s: float        # seconds from schedule start
+    image1: np.ndarray
+    image2: np.ndarray
+
+
+class StepTraffic:
+    """A deterministic multi-phase arrival schedule.
+
+    Due times accumulate across phases: phase k+1's first request is
+    due one of ITS intervals after phase k's last — a step is a rate
+    change at an instant, not a gap. Frame content is keyed on the
+    global emission index through ``SyntheticFlowDataset`` exactly like
+    the single-rate stream, so two runs (or two benches) replaying the
+    same ``(seed, phases)`` submit identical bytes.
+    """
+
+    def __init__(
+        self,
+        size_hw: Tuple[int, int],
+        phases: List[TrafficPhase],
+        *,
+        seed: int = 0,
+        burst_size: int = 8,
+        chaos: Optional[ChaosSpec] = None,
+        style: str = "smooth",
+    ):
+        if not phases:
+            raise ValueError("a schedule needs at least one phase")
+        names = [p.name for p in phases]
+        if len(set(names)) != len(names):
+            raise ValueError(f"phase names must be unique: {names}")
+        self.size_hw = tuple(size_hw)
+        self.phases = list(phases)
+        self.burst_size = max(1, int(burst_size))
+        self.chaos = chaos or ChaosSpec()
+        self.n_requests = sum(p.n_requests for p in phases)
+        live_bursts = sum(
+            1 for i in self.chaos.burst_requests if i < self.n_requests
+        )
+        self._total = self.n_requests + live_bursts * (self.burst_size - 1)
+        self._ds = SyntheticFlowDataset(
+            self.size_hw, length=max(1, self._total), seed=seed,
+            style=style,
+        )
+
+    @classmethod
+    def step(
+        cls,
+        size_hw: Tuple[int, int],
+        *,
+        low_n: int = 8,
+        high_n: int = 24,
+        low_interval_s: float = 0.25,
+        high_interval_s: float = 0.02,
+        seed: int = 0,
+        **kw,
+    ) -> "StepTraffic":
+        """The canonical elasticity scenario: low → high → low. The
+        high phase is what must force a scale-up; the trailing low
+        phase is what must let the scale-down drain with zero loss."""
+        return cls(size_hw, [
+            TrafficPhase("low", low_n, low_interval_s),
+            TrafficPhase("high", high_n, high_interval_s),
+            TrafficPhase("cooldown", low_n, low_interval_s),
+        ], seed=seed, **kw)
+
+    def __len__(self) -> int:
+        return self._total
+
+    def phase_bounds(self) -> Dict[str, Tuple[int, int]]:
+        """``{phase name: (first, past-last)}`` in GLOBAL request
+        indices — what turns a per-request latency list into per-phase
+        percentiles, and what aims chaos coordinates at a phase."""
+        bounds: Dict[str, Tuple[int, int]] = {}
+        start = 0
+        for p in self.phases:
+            bounds[p.name] = (start, start + p.n_requests)
+            start += p.n_requests
+        return bounds
+
+    def schedule(self) -> Iterator[TrafficItem]:
+        """The rich schedule: every arrival with its phase attribution.
+        Burst copies share their trigger's index, phase, and due time
+        (they ARE request N, multiplied)."""
+        emitted = 0
+        index = 0
+        due = 0.0
+        for p in self.phases:
+            for _ in range(p.n_requests):
+                due += p.interval_s
+                copies = (
+                    self.burst_size
+                    if index in self.chaos.burst_requests else 1
+                )
+                for _ in range(copies):
+                    sample = self._ds.sample(emitted)
+                    img1, img2 = sample["image1"], sample["image2"]
+                    if index in self.chaos.poison_requests:
+                        img1 = np.full(img1.shape, np.nan, np.float32)
+                    emitted += 1
+                    yield TrafficItem(
+                        index=index, phase=p.name, due_s=due,
+                        image1=img1, image2=img2,
+                    )
+                index += 1
+
+    def __iter__(self) -> Iterator[Tuple[float, np.ndarray, np.ndarray]]:
+        """``serving/traffic.replay`` compatibility: bare
+        ``(due_s, image1, image2)`` triples."""
+        for item in self.schedule():
+            yield item.due_s, item.image1, item.image2
+
+    def items(self) -> Iterator[dict]:
+        """``fleet/router.replay_fleet`` compatibility: one dict per
+        arrival (extra keys ride along for the bench's attribution)."""
+        for item in self.schedule():
+            yield {
+                "image1": item.image1,
+                "image2": item.image2,
+                "due_s": item.due_s,
+                "phase": item.phase,
+                "index": item.index,
+            }

@@ -40,7 +40,7 @@ def recommend(record: dict) -> list[str]:
             record
         ) + _pipeline_lines(record) + _fleet_lines(
             record
-        ) + _telemetry_lines(record)
+        ) + _elasticity_lines(record) + _telemetry_lines(record)
 
     corr = {"volume": record.get("value")}
     for tag in ("onthefly", "pallas"):
@@ -109,6 +109,7 @@ def recommend(record: dict) -> list[str]:
     lines.extend(_uhd_row_lines(record))
     lines.extend(_pipeline_lines(record))
     lines.extend(_fleet_lines(record))
+    lines.extend(_elasticity_lines(record))
     lines.extend(_telemetry_lines(record))
 
     nc = record.get("pairs_per_sec_nconv_pallas")
@@ -672,6 +673,106 @@ def _fleet_lines(record: dict) -> list[str]:
                 f"fleet telemetry: measured overhead {overhead:.1f}% of "
                 "p50 (within the 3% budget)"
             )
+    return lines
+
+
+def _elasticity_lines(record: dict) -> list[str]:
+    """Elasticity row (bench.py ``elasticity_*`` fields; docs/FLEET.md
+    "Elasticity bench") — the fleet-row policy INVERTED: that row must
+    measure service (any shed disqualifies it), this row must measure
+    the machinery. Absent row → no lines (older records predate the
+    autoscaler); any in-flight loss, drain-contract violation, or open
+    breaker → the cycle is UNSAFE and nothing else about the row
+    matters; a leaking replica → the latencies are unusable; otherwise
+    the verdict is whether the elastic cycle CLOSED — the load step
+    forced a scale-up, the capacity reached READY, and the post-burst
+    calm gave it back — with the warmup-window sheds carrying
+    ETA-floored (not treadmill-default) retry hints."""
+    n_req = record.get("elasticity_requests")
+    if n_req is None:
+        return []
+    losses = record.get("elasticity_losses") or 0
+    violations = record.get("elasticity_contract_violations") or []
+    breaker = record.get("elasticity_breaker_open")
+    if losses or violations or breaker:
+        detail = []
+        if losses:
+            detail.append(f"{losses} lost in-flight response(s)")
+        if violations:
+            detail.append(
+                f"{len(violations)} drain-contract violation(s): "
+                f"{violations}"
+            )
+        if breaker:
+            detail.append(
+                "autoscaler breaker OPEN (consecutive failed scale-ups)"
+            )
+        return [
+            f"elasticity: cycle UNSAFE ({'; '.join(detail)}) — elastic "
+            "scaling may NOT be enabled on this build; fix the loss "
+            "path (docs/FLEET.md drain contract) and rerun bench"
+        ]
+    recompiles = record.get("elasticity_replica_recompiles") or []
+    transfers = record.get("elasticity_replica_host_transfers") or []
+    dirty = [
+        i for i, (r, t) in enumerate(zip(recompiles, transfers))
+        if (r is None or r) or (t is None or t)
+    ]
+    if dirty:
+        return [
+            "elasticity: INVARIANT VIOLATED on serving replica(s) "
+            f"{dirty} (recompiles {recompiles}, implicit host transfers "
+            f"{transfers}; None = report missing) — the elasticity "
+            "latencies include a leaking or recompiling replica; fix it "
+            "before reading them"
+        ]
+    ups = record.get("elasticity_scale_ups") or 0
+    ups_done = record.get("elasticity_scale_ups_completed") or 0
+    downs = record.get("elasticity_scale_downs") or 0
+    shed = record.get("elasticity_shed") or 0
+    floored = record.get("elasticity_shed_eta_floored") or 0
+    ttr = record.get("elasticity_time_to_ready_s")
+    lines = []
+    if not ups:
+        lines.append(
+            f"elasticity: step never pressured the fleet (0 scale-ups "
+            f"over {n_req} requests, {shed} shed) — no elasticity "
+            "verdict; raise BENCH_ELASTICITY_HIGH or check the "
+            "calibrated interval before reading the row"
+        )
+    elif ups_done < ups:
+        lines.append(
+            f"elasticity: cycle OPEN — {ups - ups_done} of {ups} "
+            "scale-up(s) never reached READY in the window "
+            f"({record.get('elasticity_failed_scale_ups') or 0} failed) "
+            "— raise BENCH_ELASTICITY_GRACE_S (spawn compile may exceed "
+            "the settle window on CPU) and rerun before judging"
+        )
+    elif downs < ups_done:
+        lines.append(
+            f"elasticity: capacity never given back ({ups_done} "
+            f"scale-up(s) READY after {ttr}s but only {downs} "
+            "scale-down(s)) — the cooldown phase or settle window is "
+            "too short for the anti-flap bounds; rerun before judging"
+        )
+    else:
+        lines.append(
+            "elasticity: cycle CLOSED — the load step scaled "
+            f"{ups} up (READY in {ttr}s measured) and the calm gave "
+            f"{downs} back with 0 lost in-flight responses "
+            f"(ok {record.get('elasticity_ok')}/{n_req}, {shed} honest "
+            f"shed(s), p50 {record.get('elasticity_p50_ms')} ms / p99 "
+            f"{record.get('elasticity_p99_ms')} ms); elastic scaling "
+            "holds its zero-loss contract on this build"
+        )
+    if shed and not floored:
+        lines.append(
+            f"elasticity: backpressure DISHONEST — {shed} shed(s) "
+            "during the window and none carried a retry hint above the "
+            "default floor; while capacity warms, sheds must quote the "
+            "time-to-READY estimate (FleetRouter.set_scale_eta), not "
+            "the re-shed treadmill"
+        )
     return lines
 
 

@@ -143,10 +143,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="drive the streaming video engine "
                         "(raft_ncup_tpu/streaming/) instead of the "
                         "request server")
-    parser.add_argument("--replica_socket", default=None, metavar="PATH",
+    parser.add_argument("--replica_socket", default=None, metavar="ADDR",
                         help="replica-server mode (raft_ncup_tpu/fleet/; "
                         "docs/FLEET.md): serve request/frame messages "
-                        "over this Unix domain socket (length-prefixed "
+                        "over this wire address — a Unix-domain-socket "
+                        "path or host:port for TCP "
+                        "(length-prefixed "
                         "JSON header + raw ndarray frames) through the "
                         "FlowServer (+ StreamEngine) instead of "
                         "replaying synthetic traffic — the child "
@@ -340,7 +342,7 @@ def run_replica(args, model, variables) -> int:
         serve_config_from_args,
         stream_config_from_args,
     )
-    from raft_ncup_tpu.fleet.wire import recv_msg, send_msg
+    from raft_ncup_tpu.fleet.wire import Transport, recv_msg, send_msg
     from raft_ncup_tpu.observability import write_healthz
     from raft_ncup_tpu.resilience import EXIT_PREEMPTED, PreemptionHandler
     from raft_ncup_tpu.serving import FlowServer
@@ -391,14 +393,11 @@ def run_replica(args, model, variables) -> int:
         file=sys.stderr,
     )
 
-    sock_path = args.replica_socket
-    try:
-        os.remove(sock_path)
-    except OSError:
-        pass
-    lsock = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
-    lsock.bind(sock_path)
-    lsock.listen(16)
+    # The address string decides the socket family (UDS path vs
+    # host:port) — the same string the FleetConfig argv carried, so a
+    # topology moves to TCP without touching the replica code path.
+    transport = Transport.parse(args.replica_socket)
+    lsock = transport.listen(16)
     lsock.settimeout(0.1)
 
     pool = ThreadPoolExecutor(
@@ -590,10 +589,7 @@ def run_replica(args, model, variables) -> int:
             except OSError:
                 pass
     lsock.close()
-    try:
-        os.remove(sock_path)
-    except OSError:
-        pass
+    transport.cleanup()
 
     report = {
         "replica": args.replica_index,

@@ -1,0 +1,390 @@
+"""Fast-tier autoscaler matrix (fleet/autoscaler.py; docs/FLEET.md
+"Autoscaler").
+
+No jax, no processes, no sleeps: a fake supervisor (handle objects),
+a fake router (counters), and an injected clock make ``tick()`` fully
+deterministic — every test asserts the EXACT decision trajectory, not
+a property of it. The chaos tier (tests/test_fleet.py) proves the same
+loop against real replica processes; this tier proves the decisions.
+"""
+
+import pytest
+
+from raft_ncup_tpu.fleet import FleetAutoscaler, FleetConfig
+from raft_ncup_tpu.fleet.replica import BROKEN, DRAINING, SPAWNING, UP
+from raft_ncup_tpu.observability import Telemetry
+
+
+class _Handle:
+    def __init__(self, index, state=UP, healthz=None):
+        self.index = index
+        self.state = state
+        self.circuit_open = False
+        self.last_healthz = healthz if healthz is not None else {
+            "overall": "ready"
+        }
+
+
+class _FakeSup:
+    """Replica handles without processes; spawn/drain mutate the list
+    the way the real supervisor's add/remove do."""
+
+    def __init__(self, indices):
+        self.replicas = [_Handle(i) for i in indices]
+
+    def handle(self, i):
+        for h in self.replicas:
+            if h.index == i:
+                return h
+        return None
+
+    def spawn(self, i):
+        self.replicas.append(_Handle(i, state=SPAWNING))
+
+    def drain(self, i):
+        self.replicas = [h for h in self.replicas if h.index != i]
+
+
+class _FakeRouter:
+    def __init__(self):
+        self.stats = {"shed": 0}
+        self.inflight = {}
+        self.scale_eta = None
+        self.eta_log = []
+
+    def inflight_of(self, i):
+        return self.inflight.get(i, 0)
+
+    def set_scale_eta(self, eta_s):
+        self.scale_eta = eta_s
+        self.eta_log.append(eta_s)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _scaler(tmp_path, sup, router, clock, **cfg_kw):
+    kw = dict(
+        n_replicas=1, min_replicas=1, max_replicas=3,
+        scale_hysteresis_ticks=2, scale_cooldown_s=5.0,
+        scale_fail_budget=2, scale_eta_prior_s=20.0,
+        max_inflight_per_replica=4,
+    )
+    kw.update(cfg_kw)
+    cfg = FleetConfig(base_dir=str(tmp_path), **kw)
+    return cfg, FleetAutoscaler(
+        cfg, sup, router, telemetry=Telemetry(), clock=clock,
+        spawn_fn=sup.spawn, drain_fn=sup.drain,
+    )
+
+
+def _trajectory(scaler, clock, n, dt=1.0):
+    out = []
+    for _ in range(n):
+        clock.t += dt
+        out.append(scaler.tick())
+    return out
+
+
+class TestScaleUp:
+    def test_saturation_trajectory_is_exact(self, tmp_path):
+        """Hysteresis holds, then ONE spawn, then in-flight blocks —
+        the exact sequence, not a property of it."""
+        sup, router, clock = _FakeSup([0]), _FakeRouter(), _Clock()
+        router.inflight[0] = 4  # occupancy 1.0 >= 0.8
+        cfg, sc = _scaler(tmp_path, sup, router, clock)
+        recs = _trajectory(sc, clock, 4)
+        assert [r["decision"] for r in recs] == [
+            "hold", "up", "hold", "hold",
+        ]
+        assert recs[0]["reason"] == "hysteresis 1/2"
+        assert recs[1]["reason"].startswith("spawned slot 1")
+        assert all(
+            r["reason"].startswith("topology change in flight")
+            for r in recs[2:]
+        )
+        assert sc.scale_ups == 1
+        assert sup.handle(1).state == SPAWNING
+
+    def test_settle_observes_time_to_ready(self, tmp_path):
+        sup, router, clock = _FakeSup([0]), _FakeRouter(), _Clock()
+        router.inflight[0] = 4
+        cfg, sc = _scaler(tmp_path, sup, router, clock)
+        _trajectory(sc, clock, 2)  # hold, up @ t=2
+        sup.handle(1).state = UP   # READY after 3 more ticks
+        clock.t = 5.0
+        rec = sc.tick()
+        assert sc.scale_ups_completed == 1
+        # First real observation REPLACES the 20s prior (3s spawn→READY).
+        assert sc.time_to_ready_s() == pytest.approx(3.0)
+        assert sc.report()["time_to_ready_observed"] == 1
+        # The settled tick can decide again (no phantom pending).
+        assert "in flight" not in rec["reason"]
+
+    def test_ttr_ewma_tracks_later_observations(self, tmp_path):
+        sup, router, clock = _FakeSup([0]), _FakeRouter(), _Clock()
+        router.inflight[0] = 4
+        cfg, sc = _scaler(tmp_path, sup, router, clock)
+        _trajectory(sc, clock, 2)
+        sup.handle(1).state = UP
+        clock.t = 4.0  # 2s observed
+        sc.tick()
+        # Second scale-up: cooldown expires at t=7; hysteresis rebuilt.
+        router.inflight[1] = 4
+        _trajectory(sc, clock, 4)  # t=5..8: streak, spawn slot 2
+        assert sup.handle(2) is not None
+        sup.handle(2).state = UP
+        t_spawn = [r for r in sc.decisions if r["decision"] == "up"][-1]["t"]
+        clock.t = t_spawn + 6.0  # 6s observed
+        sc.tick()
+        assert sc.time_to_ready_s() == pytest.approx(
+            0.5 * 2.0 + 0.5 * 6.0
+        )
+
+    def test_at_max_replicas_holds_with_reason(self, tmp_path):
+        sup, router, clock = _FakeSup([0, 1, 2]), _FakeRouter(), _Clock()
+        for i in range(3):
+            router.inflight[i] = 4
+        cfg, sc = _scaler(tmp_path, sup, router, clock, n_replicas=3)
+        recs = _trajectory(sc, clock, 3)
+        assert [r["decision"] for r in recs] == ["hold"] * 3
+        assert recs[-1]["reason"] == "at max_replicas (3)"
+        assert sc.scale_ups == 0
+
+    def test_paging_and_shed_delta_trigger_without_occupancy(
+        self, tmp_path
+    ):
+        """Pressure is paging OR occupancy OR a fresh shed — an SLO
+        burn page at 10% occupancy must still scale."""
+        sup, router, clock = _FakeSup([0]), _FakeRouter(), _Clock()
+        sup.handle(0).last_healthz = {
+            "overall": "ready",
+            "slo": {"paging": ["availability"],
+                    "verdicts": {"availability": {"burn_fast": 14.4}}},
+        }
+        cfg, sc = _scaler(tmp_path, sup, router, clock)
+        recs = _trajectory(sc, clock, 2)
+        assert recs[1]["decision"] == "up"
+        assert recs[1]["paging"] == ["availability"]
+        assert recs[1]["burn_fast"] == pytest.approx(14.4)
+        # Fresh fleet, shed counter moving: same verdict.
+        sup2, router2, clock2 = _FakeSup([0]), _FakeRouter(), _Clock()
+        cfg2, sc2 = _scaler(tmp_path, sup2, router2, clock2)
+        for _ in range(2):
+            router2.stats["shed"] += 3
+            clock2.t += 1.0
+            rec = sc2.tick()
+            assert rec["shed_delta"] == 3
+        assert rec["decision"] == "up"
+
+
+class TestScaleDown:
+    def _calm_fleet(self, tmp_path, n=2):
+        sup, router, clock = _FakeSup(list(range(n))), _FakeRouter(), _Clock()
+        cfg, sc = _scaler(tmp_path, sup, router, clock, n_replicas=n)
+        return sup, router, clock, cfg, sc
+
+    def test_calm_trajectory_drains_exactly_one(self, tmp_path):
+        sup, router, clock, cfg, sc = self._calm_fleet(tmp_path)
+        recs = _trajectory(sc, clock, 4)
+        assert [r["decision"] for r in recs] == [
+            "hold", "down", "hold", "hold",
+        ]
+        # Drain completed instantly (fake), so later holds are steady
+        # "at min_replicas", not in-flight blocks.
+        assert recs[2]["reason"] == "at min_replicas (1)"
+        assert sc.scale_downs == 1
+        assert [h.index for h in sup.replicas] == [0]
+
+    def test_victim_is_least_loaded_ties_retire_newest(self, tmp_path):
+        sup, router, clock, cfg, sc = self._calm_fleet(tmp_path, n=3)
+        router.inflight = {0: 1, 1: 0, 2: 0}  # occ 1/12 <= 0.25
+        _trajectory(sc, clock, 2)
+        # 1 and 2 tie on load; the NEWEST slot retires so the stable
+        # low-index replicas keep their warm streams sticky.
+        assert [h.index for h in sup.replicas] == [0, 1]
+
+    def test_min_replicas_floor_holds(self, tmp_path):
+        sup, router, clock = _FakeSup([0]), _FakeRouter(), _Clock()
+        cfg, sc = _scaler(tmp_path, sup, router, clock)
+        recs = _trajectory(sc, clock, 3)
+        assert [r["decision"] for r in recs] == ["hold"] * 3
+        assert recs[-1]["reason"] == "at min_replicas (1)"
+
+    def test_draining_replica_not_counted_up(self, tmp_path):
+        sup, router, clock, cfg, sc = self._calm_fleet(tmp_path)
+        sup.handle(1).state = DRAINING
+        rec = sc.tick()
+        assert rec["n_up"] == 1
+        assert rec["n_draining"] == 1
+        assert rec["reason"] == "at min_replicas (1)"
+
+
+class TestAntiFlap:
+    def test_oscillating_signal_never_scales(self, tmp_path):
+        """The flap scenario: load alternating sat/idle each tick —
+        period shorter than hysteresis — must produce zero topology
+        changes, ever."""
+        sup, router, clock = _FakeSup([0, 1]), _FakeRouter(), _Clock()
+        cfg, sc = _scaler(tmp_path, sup, router, clock, n_replicas=2)
+        for k in range(12):
+            load = 4 if k % 2 == 0 else 0
+            router.inflight = {0: load, 1: load}
+            clock.t += 1.0
+            rec = sc.tick()
+            assert rec["decision"] == "hold", rec
+        assert sc.scale_ups == 0 and sc.scale_downs == 0
+
+    def test_cooldown_blocks_consecutive_scale_ups(self, tmp_path):
+        sup, router, clock = _FakeSup([0]), _FakeRouter(), _Clock()
+        router.inflight[0] = 4
+        cfg, sc = _scaler(tmp_path, sup, router, clock)
+        _trajectory(sc, clock, 2)       # up @ t=2
+        sup.handle(1).state = UP        # settles immediately
+        router.inflight[1] = 4          # still saturated
+        recs = _trajectory(sc, clock, 4)  # t=3..6 < cooldown end (t=7)
+        assert [r["decision"] for r in recs] == ["hold"] * 4
+        assert recs[-1]["reason"] == "cooldown"
+        recs = _trajectory(sc, clock, 1)  # t=7: cooldown satisfied
+        assert recs[0]["decision"] == "up"
+
+    def test_mid_band_occupancy_resets_both_streaks(self, tmp_path):
+        """Between the thresholds is a healthy steady state: one
+        mid-band tick must erase accumulated evidence in BOTH
+        directions."""
+        sup, router, clock = _FakeSup([0]), _FakeRouter(), _Clock()
+        cfg, sc = _scaler(tmp_path, sup, router, clock,
+                          scale_hysteresis_ticks=2)
+        router.inflight[0] = 4          # pressure: streak 1
+        _trajectory(sc, clock, 1)
+        router.inflight[0] = 2          # 0.5: neither
+        rec = _trajectory(sc, clock, 1)[0]
+        assert rec["reason"] == "steady"
+        router.inflight[0] = 4          # pressure again: streak restarts
+        rec = _trajectory(sc, clock, 1)[0]
+        assert rec["reason"] == "hysteresis 1/2"
+
+
+class TestFailBudgetBreaker:
+    def test_breaker_opens_after_budget_and_blocks_spawns(
+        self, tmp_path
+    ):
+        sup, router, clock = _FakeSup([0]), _FakeRouter(), _Clock()
+        router.inflight[0] = 4
+        cfg, sc = _scaler(tmp_path, sup, router, clock,
+                          scale_cooldown_s=0.001)
+        fails = 0
+        while not sc.breaker_open:
+            clock.t += 1.0
+            rec = sc.tick()
+            if rec["decision"] == "up":
+                # The spawned replica breaks before ever reaching READY.
+                spawned = [h for h in sup.replicas
+                           if h.state == SPAWNING]
+                spawned[0].state = BROKEN
+                fails += 1
+            assert fails <= cfg.scale_fail_budget + 1
+        assert sc.failed_scale_ups == cfg.scale_fail_budget == 2
+        clock.t += 1.0
+        rec = sc.tick()
+        assert rec["decision"] == "hold"
+        assert rec["reason"].startswith("breaker open after 2 failed")
+        assert "respawn storm bounded" in rec["reason"]
+        before = sc.scale_ups
+        _trajectory(sc, clock, 3)
+        assert sc.scale_ups == before
+
+    def test_successful_scale_up_resets_fail_streak(self, tmp_path):
+        sup, router, clock = _FakeSup([0]), _FakeRouter(), _Clock()
+        router.inflight[0] = 4
+        cfg, sc = _scaler(tmp_path, sup, router, clock,
+                          scale_cooldown_s=0.001)
+        _trajectory(sc, clock, 2)  # up: slot 1
+        sup.handle(1).state = BROKEN  # fail #1
+        _trajectory(sc, clock, 1)     # settle; streak rebuilds
+        sup.drain(1)
+        _trajectory(sc, clock, 2)     # up again: slot 1
+        sup.handle(1).state = UP      # SUCCESS — streak must reset
+        _trajectory(sc, clock, 1)
+        assert sc.failed_scale_ups == 1
+        assert not sc.breaker_open
+        router.inflight = {0: 4, 1: 4}
+        _trajectory(sc, clock, 2)     # next up still allowed
+        assert sc.scale_ups == 3
+
+
+class TestEtaPublication:
+    def test_eta_floors_sheds_while_warming_and_clears_calm(
+        self, tmp_path
+    ):
+        sup, router, clock = _FakeSup([0]), _FakeRouter(), _Clock()
+        router.inflight[0] = 4
+        cfg, sc = _scaler(tmp_path, sup, router, clock)
+        _trajectory(sc, clock, 1)
+        # Pressure (even pre-decision): the ETA is already honest.
+        assert router.scale_eta == pytest.approx(20.0)
+        _trajectory(sc, clock, 2)  # up + warming
+        assert router.scale_eta == pytest.approx(20.0)
+        sup.handle(1).state = UP
+        router.inflight = {0: 0, 1: 0}  # calm
+        clock.t += 1.0
+        sc.tick()
+        assert router.scale_eta is None
+
+    def test_stop_clears_a_published_eta(self, tmp_path):
+        sup, router, clock = _FakeSup([0]), _FakeRouter(), _Clock()
+        router.inflight[0] = 4
+        cfg, sc = _scaler(tmp_path, sup, router, clock)
+        _trajectory(sc, clock, 1)
+        assert router.scale_eta is not None
+        sc.stop()  # no thread running: stop is still the eta janitor
+        assert router.scale_eta is None
+
+
+class TestSignalsAndReport:
+    def test_empty_fleet_reads_as_saturated(self, tmp_path):
+        sup, router, clock = _FakeSup([0]), _FakeRouter(), _Clock()
+        cfg, sc = _scaler(tmp_path, sup, router, clock)
+        sup.handle(0).state = BROKEN
+        s = sc.signals()
+        assert s["n_up"] == 0
+        assert s["occupancy"] == 1.0  # pressure, not 0% busy
+
+    def test_circuit_open_handle_is_not_capacity(self, tmp_path):
+        sup, router, clock = _FakeSup([0, 1]), _FakeRouter(), _Clock()
+        sup.handle(1).circuit_open = True
+        cfg, sc = _scaler(tmp_path, sup, router, clock, n_replicas=2)
+        s = sc.signals()
+        assert s["up_indices"] == [0]
+
+    def test_report_shape_and_decision_log(self, tmp_path):
+        sup, router, clock = _FakeSup([0]), _FakeRouter(), _Clock()
+        cfg, sc = _scaler(tmp_path, sup, router, clock)
+        _trajectory(sc, clock, 3)
+        rep = sc.report()
+        assert rep["ticks"] == 3
+        for key in ("scale_ups", "scale_ups_completed", "scale_downs",
+                    "failed_scale_ups", "breaker_open",
+                    "time_to_ready_s", "time_to_ready_observed"):
+            assert key in rep
+        for rec in sc.decisions:
+            for key in ("t", "decision", "reason", "occupancy",
+                        "eta_published", "breaker_open"):
+                assert key in rec
+
+    def test_background_loop_ticks_and_stops(self, tmp_path):
+        import time as _time
+
+        sup, router = _FakeSup([0]), _FakeRouter()
+        cfg, sc = _scaler(tmp_path, sup, router, _time.monotonic)
+        with sc.start(interval_s=0.01):
+            deadline = _time.monotonic() + 5.0
+            while len(sc.decisions) < 3 and _time.monotonic() < deadline:
+                _time.sleep(0.01)
+        assert len(sc.decisions) >= 3
+        assert router.scale_eta is None  # stop() cleared it

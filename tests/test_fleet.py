@@ -12,11 +12,16 @@ processes — killreplica (SIGKILL) mid-stream with bitwise surviving-
 replica parity, drainreplica with zero in-flight losses + the
 DRAINING/exit-75 contract, stallreplica through the healthz staleness
 contract, restart accounting, and the postmortem reassembly of a
-request's journey across the router hop.
+request's journey across the router hop. Plus the elastic-fleet tier:
+partitionhost/killsupervisor against a REAL multi-host TCP fleet
+(HostSupervisor agents, fencing, fleet-level staleness) and the
+autoscaler driving a real scale-down-under-load → scale-up cycle with
+zero in-flight loss.
 """
 
 import json
 import os
+import signal
 import socket
 import sys
 import threading
@@ -28,7 +33,9 @@ import pytest
 from raft_ncup_tpu.config import ServeConfig, StreamConfig
 from raft_ncup_tpu.fleet import (
     ChildProcess,
+    FleetAutoscaler,
     FleetConfig,
+    FleetManager,
     FleetRouter,
     ReplicaSupervisor,
     healthz_fresh,
@@ -38,11 +45,18 @@ from raft_ncup_tpu.fleet import (
 from raft_ncup_tpu.fleet.replica import (
     BROKEN,
     DEAD,
+    SPAWNING,
     UP,
     last_json_line,
 )
 from raft_ncup_tpu.fleet.router import rendezvous_choice
-from raft_ncup_tpu.fleet.wire import recv_msg, send_msg
+from raft_ncup_tpu.fleet.wire import (
+    FrameTimeout,
+    Transport,
+    recv_msg,
+    send_msg,
+    set_read_timeout,
+)
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -258,6 +272,172 @@ class TestWire:
         a.close(), b.close()
 
 
+# ----------------------------------------- transport address abstraction
+
+
+class TestTransport:
+    def test_parse_matrix(self):
+        """The ONE address string both ends share decides the family —
+        the parse is syntactic and total, so a topology moves from UDS
+        to TCP by changing addresses, nothing else."""
+        t = Transport.parse("127.0.0.1:5001")
+        assert t.is_inet and (t.host, t.port) == ("127.0.0.1", 5001)
+        assert t.render() == "127.0.0.1:5001"
+        assert Transport.parse("replica-host:65000").is_inet
+        # Anything with a path separator is a UDS path, colon or not.
+        t = Transport.parse("/tmp/fleet/replica_0.sock")
+        assert not t.is_inet and t.path == "/tmp/fleet/replica_0.sock"
+        assert Transport.parse("/tmp/odd:5000/x.sock").path.endswith(
+            "x.sock"
+        )
+        # No host:digits shape -> UDS path, verbatim.
+        assert not Transport.parse("replica.sock").is_inet
+        assert not Transport.parse("host:notaport").is_inet
+        assert not Transport.parse(":5000").is_inet
+        with pytest.raises(ValueError):
+            Transport.parse("")
+
+    def test_topology_addresses_swap_family_only(self, tmp_path):
+        uds = FleetConfig(base_dir=str(tmp_path), n_replicas=2)
+        tcp = FleetConfig(
+            base_dir=str(tmp_path), n_replicas=2,
+            transport="tcp", base_port=15000,
+        )
+        assert not Transport.parse(uds.replica_address(1)).is_inet
+        t = Transport.parse(tcp.replica_address(1))
+        assert t.is_inet and t.port == 15001
+        # Host-agent control ports sit directly above the replica slots.
+        tcp_h = FleetConfig(
+            base_dir=str(tmp_path), n_replicas=2,
+            transport="tcp", base_port=15000, hosts=("hA", "hB"),
+        )
+        assert Transport.parse(
+            tcp_h.host_control_address("hB")
+        ).port == 15003
+
+    def test_listen_connect_cleanup_uds(self, tmp_path):
+        addr = str(tmp_path / "t.sock")
+        t = Transport.parse(addr)
+        lsock = t.listen(2)
+        # A stale path from a dead incarnation must not lock out the
+        # next listener.
+        lsock.close()
+        lsock = t.listen(2)
+        client = t.connect(timeout_s=5.0)
+        server, _ = lsock.accept()
+        send_msg(client, {"kind": "ping"})
+        assert recv_msg(server)[0] == {"kind": "ping"}
+        client.close(), server.close(), lsock.close()
+        t.cleanup()
+        assert not os.path.exists(addr)
+
+
+def _tcp_pair():
+    """A connected (client, server) TCP pair through the real
+    Transport listen/connect path on an ephemeral loopback port."""
+    lsock = Transport(socket.AF_INET, host="127.0.0.1", port=0).listen(4)
+    port = lsock.getsockname()[1]
+    client = Transport.parse(f"127.0.0.1:{port}").connect(timeout_s=5.0)
+    server, _ = lsock.accept()
+    lsock.close()
+    return client, server
+
+
+class TestWireInet:
+    """Satellite: the framing contract re-pinned for the INET family,
+    plus the failure modes only a LAN shows — torn frames at seeded
+    truncation points, slow-loris dribble, and half-open silence under
+    ``SO_RCVTIMEO``."""
+
+    def test_roundtrip_and_clean_eof_inet(self):
+        client, server = _tcp_pair()
+        img = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+        send_msg(client, {"kind": "request", "id": 1}, [img])
+        header, arrays = recv_msg(server)
+        assert header == {"kind": "request", "id": 1}
+        np.testing.assert_array_equal(arrays[0], img)
+        # Clean EOF at a frame boundary is None over TCP exactly as
+        # over UDS: a closed peer between frames is not an error.
+        client.close()
+        assert recv_msg(server) is None
+        server.close()
+
+    def test_keepalive_and_nodelay_armed(self):
+        client, server = _tcp_pair()
+        assert client.getsockopt(
+            socket.SOL_SOCKET, socket.SO_KEEPALIVE
+        ) != 0
+        assert client.getsockopt(
+            socket.IPPROTO_TCP, socket.TCP_NODELAY
+        ) != 0
+        client.close(), server.close()
+
+    @staticmethod
+    def _frame_bytes():
+        import struct
+
+        img = np.arange(12, dtype=np.float32)
+        blob = json.dumps({
+            "kind": "request", "id": 9,
+            "arrays": [{"shape": [12], "dtype": "float32"}],
+        }).encode()
+        return struct.pack(">I", len(blob)) + blob + img.tobytes()
+
+    def test_torn_frames_at_every_seeded_truncation_point(self):
+        """A peer death at ANY byte offset inside a frame must raise
+        ConnectionError — never return a half-trusted frame, never
+        hang. Offset 0 is the one clean EOF."""
+        frame = self._frame_bytes()
+        header_len = 4 + len(frame[4:]) - 48  # 4 + blob; payload is 48
+        cuts = [0, 1, 3, 4, 4 + 7, header_len, header_len + 1,
+                header_len + 47]
+        for cut in cuts:
+            client, server = _tcp_pair()
+            client.sendall(frame[:cut])
+            client.close()
+            if cut == 0:
+                assert recv_msg(server) is None, f"cut={cut}"
+            else:
+                with pytest.raises(ConnectionError):
+                    recv_msg(server)
+            server.close()
+        # And the untruncated frame still parses (the cut points were
+        # the fault, not the frame).
+        client, server = _tcp_pair()
+        client.sendall(frame)
+        header, arrays = recv_msg(server)
+        assert header["id"] == 9 and arrays[0].shape == (12,)
+        client.close(), server.close()
+
+    def test_boundary_silence_is_frame_timeout(self):
+        """No bytes within the read deadline at a frame boundary: the
+        link is merely idle (or half-open — the router's link reader
+        answers with a ping probe). FrameTimeout, not ConnectionError."""
+        client, server = _tcp_pair()
+        set_read_timeout(server, 0.15)
+        t0 = time.monotonic()
+        with pytest.raises(FrameTimeout):
+            recv_msg(server)
+        assert time.monotonic() - t0 < 5.0
+        # The link is still usable after a boundary timeout.
+        send_msg(client, {"kind": "ping"})
+        assert recv_msg(server)[0] == {"kind": "ping"}
+        client.close(), server.close()
+
+    def test_slow_loris_mid_frame_is_connection_error(self):
+        """A peer that sends the length prefix (or half the header) and
+        then dribbles nothing holds a reader hostage forever without a
+        deadline — with one, the frame is as dead as a torn one."""
+        frame = self._frame_bytes()
+        for cut in (4, 10):
+            client, server = _tcp_pair()
+            set_read_timeout(server, 0.15)
+            client.sendall(frame[:cut])
+            with pytest.raises(ConnectionError):
+                recv_msg(server)
+            client.close(), server.close()
+
+
 # ------------------------------------------------------------ lifecycle
 
 
@@ -435,9 +615,12 @@ class _FakeReplica:
         self.telemetry_enabled = True
         self.seen = []
         self._n = 0
-        self._lsock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._lsock.bind(spec.socket_path)
-        self._lsock.listen(4)
+        # Listen wherever the topology put this replica — UDS path or
+        # host:port, decided by the same Transport parse serve.py uses.
+        self._transport = Transport.parse(
+            spec.address or spec.socket_path
+        )
+        self._lsock = self._transport.listen(4)
         self._lsock.settimeout(0.1)
         self._stop = threading.Event()
         self._threads = [threading.Thread(
@@ -518,6 +701,31 @@ class _FakeReplica:
     def close(self):
         self._stop.set()
         self._lsock.close()
+        # No transport.cleanup(): the socket path staying behind is how
+        # aggregate.py knows replica slots EXISTED (gap detection).
+
+
+def _free_base_port(n, tries=50):
+    """A base port with ``n`` consecutive free loopback ports above it
+    (TCP fleet topologies allocate replica + control ports as a
+    contiguous block)."""
+    rng = np.random.default_rng()
+    for _ in range(tries):
+        base = int(rng.integers(20000, 60000))
+        socks = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError(f"no {n} consecutive free ports found")
 
 
 def _fake_fleet(tmp_path, plans, retry_afters, **cfg_kw):
@@ -615,6 +823,77 @@ class TestRouterAgainstFakes:
             assert r.status == "shed"
             assert "no admittable replica" in r.detail
             assert r.retry_after_s >= cfg.default_retry_after_s
+        finally:
+            router.drain(timeout=0.2)
+            [f.close() for f in fakes]
+
+    def test_tcp_transport_end_to_end_with_fakes(self, tmp_path):
+        """The family swap is addresses, nothing else: the same router,
+        supervisor handles, and fakes work over host:port with zero
+        code branches in the test."""
+        base = _free_base_port(2)
+        cfg, sup, router, fakes = _fake_fleet(
+            tmp_path, [["ok"], ["ok"]], [1.0, 1.0],
+            transport="tcp", base_port=base,
+        )
+        try:
+            assert cfg.replica(0).address == f"127.0.0.1:{base}"
+            assert Transport.parse(cfg.replica(1).address).port == base + 1
+            rs = [
+                router.submit(_img(), _img()).result(timeout=10)
+                for _ in range(4)
+            ]
+            assert [r.status for r in rs] == ["ok"] * 4
+            assert router.report()["per_replica_dispatched"] == {
+                0: 2, 1: 2,
+            }
+        finally:
+            router.drain()
+            [f.close() for f in fakes]
+
+    def test_shed_retry_after_scaled_by_scale_eta(self, tmp_path):
+        """Satellite regression: a shed at min_replicas + all-busy must
+        carry the autoscaler's time-to-READY estimate, not the 250ms
+        default — a client told "retry in 250ms" during a cold compile
+        just re-sheds; one told the ETA lands on the new capacity."""
+        cfg, sup, router, fakes = _fake_fleet(
+            tmp_path, [["hold"]], [1.0],
+            max_inflight_per_replica=1,
+            default_retry_after_s=0.25,
+            min_replicas=1, max_replicas=2,
+        )
+        try:
+            router.submit(_img(), _img())  # held forever: at capacity
+            r = router.submit(_img(), _img()).result(timeout=10)
+            assert r.status == "shed"
+            assert r.retry_after_s == pytest.approx(0.25)
+            # The autoscaler's published estimate floors every shed.
+            router.set_scale_eta(12.5)
+            r = router.submit(_img(), _img()).result(timeout=10)
+            assert r.status == "shed"
+            assert r.retry_after_s >= 12.5
+            # Cleared (scale-up settled / calm): back to the default.
+            router.set_scale_eta(None)
+            r = router.submit(_img(), _img()).result(timeout=10)
+            assert r.status == "shed"
+            assert r.retry_after_s == pytest.approx(0.25)
+            # End-to-end with the real loop: one tick under saturation
+            # publishes the prior; the next shed carries it.
+            from raft_ncup_tpu.fleet import FleetAutoscaler
+            from raft_ncup_tpu.observability import Telemetry
+
+            scaler = FleetAutoscaler(
+                cfg, sup, router, telemetry=Telemetry(),
+            )
+            rec = scaler.tick()
+            assert rec["occupancy"] == 1.0  # all-busy at min_replicas
+            r = router.submit(_img(), _img()).result(timeout=10)
+            assert r.status == "shed"
+            assert r.retry_after_s >= cfg.scale_eta_prior_s
+            assert r.retry_after_s >= scaler.time_to_ready_s()
+            scaler.stop()
+            r = router.submit(_img(), _img()).result(timeout=10)
+            assert r.retry_after_s == pytest.approx(0.25)
         finally:
             router.drain(timeout=0.2)
             [f.close() for f in fakes]
@@ -956,6 +1235,52 @@ class TestReplayFleetChaos:
             assert got["kill"] == router.replica_of(1)
             assert got["stall"] == router.replica_of(2)
             assert got["drain"] == router.replica_of(3)
+        finally:
+            router.drain()
+            [f.close() for f in fakes]
+
+    def test_host_kinds_target_the_carriers_host_via_manager(
+        self, tmp_path
+    ):
+        """Fleet-scale grammar: partitionhost@N / killsupervisor@N hit
+        the HOST of submission N's carrier, derived through the
+        placement — the manager records the blast, traffic continues."""
+        from raft_ncup_tpu.fleet import replay_fleet
+        from raft_ncup_tpu.resilience.chaos import ChaosSpec
+
+        cfg, sup, router, fakes = _fake_fleet(
+            tmp_path, [["ok"], ["ok"]], [1.0, 1.0],
+            hosts=("hA", "hB"),  # round-robin: 0 -> hA, 1 -> hB
+        )
+
+        class _RecordingManager:
+            def __init__(self, cfg):
+                self.cfg = cfg
+                self.calls = []
+
+            def host_of(self, i):
+                return self.cfg.host_of(i)
+
+            def partition(self, host):
+                self.calls.append(("partition", host))
+
+            def kill_agent(self, host):
+                self.calls.append(("kill_agent", host))
+
+        mgr = _RecordingManager(cfg)
+        try:
+            spec = ChaosSpec.parse("partitionhost@1,killsupervisor@2")
+            items = [
+                {"image1": _img(), "image2": _img()} for _ in range(4)
+            ]
+            handles = replay_fleet(
+                router, items, chaos=spec, manager=mgr,
+            )
+            for h in handles:
+                assert h.result(timeout=10).status == "ok"
+            got = dict(mgr.calls)
+            assert got["partition"] == cfg.host_of(router.replica_of(1))
+            assert got["kill_agent"] == cfg.host_of(router.replica_of(2))
         finally:
             router.drain()
             [f.close() for f in fakes]
@@ -1440,3 +1765,477 @@ class TestFleetBlastRadius:
             if body is not None:
                 assert body.get("recompiles") == 0, (idx, body)
                 assert body.get("host_transfers") == 0, (idx, body)
+
+
+# ----------------------------------------------------- elastic fleet tier
+
+
+def _proc_alive(pid):
+    """True iff ``pid`` exists AND is not a zombie. ``os.kill(pid, 0)``
+    succeeds on zombies, so fencing assertions must read the /proc stat
+    state instead (a SIGKILLed orphan reparented to a non-reaping init
+    lingers as Z forever)."""
+    try:
+        with open(f"/proc/{pid}/stat") as fh:
+            stat = fh.read()
+    except OSError:
+        return False
+    return stat.rpartition(")")[2].split()[0] != "Z"
+
+
+@pytest.mark.slow
+class TestElasticFleetChaos:
+    """Fleet-scale chaos against a REAL multi-host TCP fleet: one
+    HostSupervisor agent per named host supervising real serve.py
+    replicas, a FleetManager mirroring their republishes over the wire.
+
+    - ``partitionhost``: the manager stops hearing one host; the
+      fleet-level staleness contract declares it dead, FENCES it
+      (SIGKILLs the lingering pids so a zombie on the far side of the
+      partition can never answer), and the router fails the stranded
+      in-flight frame over to a survivor — with bitwise parity against
+      an uninjected reference for everything the survivors answered.
+    - ``killsupervisor``: SIGKILL the agent only; its replica lingers
+      as a live orphan still heartbeating files nobody republishes —
+      until the same staleness → fence path reaps it.
+    - the autoscaler runs its whole elastic cycle on a real fleet:
+      scale-down WITH work in flight on the victim (zero loss, exit
+      75), then a load step forcing a scale-up through the pre-warm
+      READY gate, then giving the capacity back when the burst clears.
+    """
+
+    def test_partitionhost_and_killsupervisor_fence_and_failover(
+        self, tmp_path
+    ):
+        from raft_ncup_tpu.observability import Telemetry
+        from raft_ncup_tpu.serving.request import TERMINAL_STATUSES
+
+        # 3 hosts, one replica each (round-robin placement), TCP
+        # transport: 3 replica ports + 3 agent control ports.
+        base = _free_base_port(6)
+        cfg = _fleet_cfg(
+            tmp_path, n=3,
+            hosts=("hA", "hB", "hC"),
+            transport="tcp", base_port=base,
+            # The per-replica staleness bound doubles as the FLEET
+            # staleness bound; 2s keeps the orphan-heartbeat window
+            # observable without slowing detection much.
+            stale_after_factor=8,
+            # No restarts: the agent's stale-kill of the suspended
+            # victim must not respawn a replica the fence would then
+            # miss (its pid would postdate the last republish).
+            max_restarts=0,
+        )
+        assert [cfg.host_of(i) for i in range(3)] == ["hA", "hB", "hC"]
+        tel = Telemetry(
+            flight_dir=os.path.join(cfg.base_dir, "router_flight")
+        )
+        manager = FleetManager(cfg, env=_mesh_env(), telemetry=tel)
+        manager.start()
+        router = FleetRouter(cfg, manager, telemetry=tel)
+
+        rng = np.random.default_rng(11)
+        streams = ("sa", "sb", "sc", "sd")
+        frames = {
+            s: [
+                rng.uniform(0, 255, (48, 64, 3)).astype(np.float32)
+                for _ in range(7)
+            ]
+            for s in streams
+        }
+        results: dict = {}   # (stream, fi) -> FlowResponse
+        carried: dict = {}   # (stream, fi) -> replica that answered
+        all_responses = []
+
+        def submit_frame(s, fi, wait=True):
+            with router._lock:
+                rid = router._next_id
+            h = router.submit(
+                frames[s][fi], frames[s][fi + 1],
+                stream_id=s, frame_index=fi,
+            )
+            if not wait:
+                return h, rid
+            r = h.result(timeout=180)
+            results[(s, fi)] = r
+            carried[(s, fi)] = router.replica_of(rid)
+            all_responses.append(r)
+            return r
+
+        def wait_host_dead(host, deadline_s=90):
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                if host in manager.report()["dead_hosts"]:
+                    return
+                time.sleep(0.1)
+            raise AssertionError(
+                f"host {host!r} never declared dead: "
+                f"{manager.report()}"
+            )
+
+        try:
+            # ---- warm: every stream answers over TCP on all 3 hosts.
+            for fi in range(2):
+                for s in streams:
+                    assert submit_frame(s, fi).status == "ok"
+            aff = dict(router.report()["affinity"])
+            assert set(aff.values()) <= {0, 1, 2}
+
+            # ---- partitionhost on sa's home, with a frame pinned in
+            # flight there: SIGSTOP the remote replica (its healthz
+            # goes stale, so the partitioned host's OWN agent stale-
+            # kills it — the real per-replica contract running on the
+            # far side), then cut the manager's control link.
+            victim = aff["sa"]
+            vhost = manager.host_of(victim)
+            vpid = manager.handle(victim).remote_pid
+            assert isinstance(vpid, int) and _proc_alive(vpid)
+            os.kill(vpid, signal.SIGSTOP)
+            h_inflight, rid_inflight = submit_frame("sa", 2, wait=False)
+            time.sleep(0.2)
+            manager.partition(vhost)
+
+            # The stranded frame failed over and completed — cold on a
+            # survivor, never silently dropped.
+            r = h_inflight.result(timeout=180)
+            results[("sa", 2)] = r
+            carried[("sa", 2)] = router.replica_of(rid_inflight)
+            all_responses.append(r)
+            assert r.status == "ok"
+            assert carried[("sa", 2)] != victim
+            assert router.stats["failovers"] >= 1
+
+            # Fleet-level staleness declared the silent host dead and
+            # fenced it: replica pid gone (or zombie), agent killed.
+            wait_host_dead(vhost)
+            rep = manager.report()
+            assert rep["partitioned_hosts"] == [vhost]
+            assert manager.handle(victim).state == DEAD
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and _proc_alive(vpid):
+                time.sleep(0.1)
+            assert not _proc_alive(vpid), (
+                f"fenced replica pid {vpid} still running"
+            )
+            assert not manager.agents[vhost].running
+
+            # Traffic continues on the survivors.
+            assert submit_frame("sa", 3).status == "ok"
+            for fi in (2, 3):
+                for s in ("sb", "sc", "sd"):
+                    assert submit_frame(s, fi).status == "ok"
+
+            # ---- killsupervisor on a SURVIVING host: the agent dies,
+            # its replica lingers as a live orphan, still heartbeating
+            # a healthz file nobody republishes anymore.
+            live = [
+                h.index for h in manager.replicas if h.state == UP
+            ]
+            assert len(live) == 2
+            orphan_idx = live[0]
+            ohost = manager.host_of(orphan_idx)
+            assert ohost != vhost
+            opid = manager.handle(orphan_idx).remote_pid
+            assert isinstance(opid, int)
+            hz1 = read_healthz(cfg.replica(orphan_idx).healthz_path)
+            assert hz1 is not None
+            manager.kill_agent(ohost)
+            assert not manager.agents[ohost].running
+            assert _proc_alive(opid)  # orphaned, not dead
+            time.sleep(0.6)
+            hz2 = read_healthz(cfg.replica(orphan_idx).healthz_path)
+            assert hz2["time_unix_s"] > hz1["time_unix_s"], (
+                "the orphan stopped heartbeating — it should outlive "
+                "its supervisor until the fleet staleness reap"
+            )
+
+            # Staleness → host death → fence: the orphan is reaped
+            # (SIGKILLed; dead or an unreaped zombie, never serving).
+            wait_host_dead(ohost)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and _proc_alive(opid):
+                time.sleep(0.1)
+            assert not _proc_alive(opid), (
+                f"orphan replica pid {opid} survived the fence"
+            )
+            assert manager.handle(orphan_idx).state == DEAD
+            assert sorted(manager.report()["dead_hosts"]) == sorted(
+                [vhost, ohost]
+            )
+
+            # The last replica standing carries everything.
+            for fi in (4, 5):
+                for s in streams:
+                    assert submit_frame(s, fi).status == "ok"
+            last = {
+                h.index for h in manager.replicas if h.state == UP
+            }
+            assert len(last) == 1
+            assert set(router.report()["affinity"].values()) == last
+        finally:
+            router.drain()
+            manager.stop()
+
+        # ---- exact terminal-status accounting: every submission
+        # reached a terminal status, zero lost, zero server-error.
+        assert all(r.status in TERMINAL_STATUSES for r in all_responses)
+        assert len(all_responses) == 24
+        assert all(r.status == "ok" for r in all_responses)
+        assert sum(
+            1 for r in all_responses if r.status == "error"
+        ) == 0
+
+        # ---- bitwise surviving-replica parity: per-stream segments
+        # replayed against an UNINJECTED single-replica UDS reference
+        # (fresh stream id per segment: a re-homed replica admits the
+        # stream cold at the segment head, warm within — PR 13's
+        # pinned semantics, now across the TCP transport).
+        def segments(s):
+            fis = sorted(fi for (ss, fi) in results if ss == s)
+            segs = []
+            for fi in fis:
+                rep_idx = carried[(s, fi)]
+                if segs and segs[-1][0] == rep_idx:
+                    segs[-1][1].append(fi)
+                else:
+                    segs.append((rep_idx, [fi]))
+            return segs
+
+        ref_cfg = _fleet_cfg(
+            tmp_path / "reference", n=1,
+            stream=StreamConfig(
+                capacity=12, iters=2, batch_sizes=(1, 2),
+                frame_hw=(48, 64), max_frame_gap=10,
+                idle_timeout_s=600.0,
+            ),
+        )
+        ref_sup = ReplicaSupervisor(ref_cfg, env=_mesh_env())
+        ref_sup.start()
+        ref_router = FleetRouter(ref_cfg, ref_sup)
+        try:
+            for s in streams:
+                for k, (rep_idx, fis) in enumerate(segments(s)):
+                    sid = f"{s}#seg{k}"
+                    for fi in fis:
+                        rr = ref_router.submit(
+                            frames[s][fi], frames[s][fi + 1],
+                            stream_id=sid, frame_index=fi,
+                        ).result(timeout=180)
+                        assert rr.status == "ok"
+                        np.testing.assert_array_equal(
+                            results[(s, fi)].flow, rr.flow,
+                            err_msg=f"{s} frame {fi} (replica "
+                            f"{rep_idx}) diverged from the uninjected "
+                            "reference",
+                        )
+        finally:
+            ref_router.drain()
+            ref_sup.stop()
+
+    def test_autoscaler_elastic_cycle_on_real_fleet_zero_loss(
+        self, tmp_path
+    ):
+        from raft_ncup_tpu.observability import Telemetry
+        from raft_ncup_tpu.serving.request import TERMINAL_STATUSES
+
+        base = _free_base_port(2)
+        cfg = _fleet_cfg(
+            tmp_path, n=2, transport="tcp", base_port=base,
+            min_replicas=1, max_replicas=2,
+            scale_hysteresis_ticks=2, scale_cooldown_s=2.0,
+            max_inflight_per_replica=4,
+            # Suspensions below must not trip the per-replica
+            # staleness contract — this test is about elasticity.
+            stale_after_factor=480,
+        )
+        tel = Telemetry()
+        sup = ReplicaSupervisor(cfg, env=_mesh_env(), telemetry=tel)
+        sup.start()
+        router = FleetRouter(cfg, sup, telemetry=tel)
+        # REAL spawn/drain paths: add_replica / threaded
+        # remove_replica, real clock, manual ticks.
+        sc = FleetAutoscaler(cfg, sup, router, telemetry=tel)
+        rng = np.random.default_rng(13)
+        img = rng.uniform(0, 255, (48, 64, 3)).astype(np.float32)
+        all_responses = []
+
+        try:
+            # ---- phase A: scale-down UNDER LOAD. Suspend both
+            # replicas so one request pins in flight on each; two calm
+            # ticks (occupancy 2/8 = 0.25) decide "down"; the victim
+            # is the NEWEST of the least-loaded tie — slot 1, which
+            # holds an in-flight request the drain must flush.
+            for h in sup.replicas:
+                h.child.suspend()
+            h1 = router.submit(img, img)
+            h2 = router.submit(img, img)
+            assert router.inflight_of(0) == 1
+            assert router.inflight_of(1) == 1
+            t1 = sc.tick()
+            assert (t1["decision"], t1["reason"]) == (
+                "hold", "hysteresis 1/2"
+            )
+            t2 = sc.tick()
+            assert t2["decision"] == "down"
+            assert t2["reason"].startswith("draining slot 1")
+            for h in sup.replicas:
+                h.child.resume()
+            r1 = h1.result(timeout=180)
+            r2 = h2.result(timeout=180)
+            all_responses += [r1, r2]
+            # ZERO in-flight loss through the scale-down.
+            assert r1.status == "ok" and r2.status == "ok", (
+                r1.status, r1.detail, r2.status, r2.detail,
+            )
+
+            deadline = time.monotonic() + 150
+            while (time.monotonic() < deadline
+                   and sc.report()["scale_downs"] < 1):
+                sc.tick()
+                time.sleep(0.2)
+            assert sc.report()["scale_downs"] == 1
+            retired = sup.retired[-1]
+            assert retired.index == 1
+            # The drain contract held: DRAINING observed, exit 75,
+            # no violations recorded.
+            assert retired.contract_violations == []
+            assert retired.child.returncode == 75
+            assert [h.index for h in sup.replicas] == [0]
+
+            # The floor is pinned: calm forever, still 1 replica.
+            sc.tick()
+            t_floor = sc.tick()
+            assert t_floor["decision"] == "hold"
+            assert t_floor["reason"] == "at min_replicas (1)"
+
+            # ---- phase B: a load step forces a scale-up through the
+            # pre-warm READY gate. Sustained arrivals beat one
+            # replica's service rate: occupancy saturates, the
+            # overflow sheds, and the autoscaler re-spawns slot 1.
+            time.sleep(2.1)  # cooldown since the scale-down
+            stop_load = threading.Event()
+            surge = threading.Event()  # high rate until the up fires
+            surge.set()
+            load_handles = []
+
+            def _load():
+                # The step must decisively beat one replica's service
+                # rate (the admission cap bounds the socket pressure;
+                # the overflow sheds at the router) — then throttle
+                # once the decision fired, keeping the warming window
+                # under load without flooding the accounting.
+                while not stop_load.is_set():
+                    load_handles.append(router.submit(img, img))
+                    load_handles.append(router.submit(img, img))
+                    time.sleep(0.004 if surge.is_set() else 0.05)
+
+            lt = threading.Thread(target=_load, daemon=True)
+            lt.start()
+            saw_up = saw_warming_hold = probed = False
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline:
+                rec = sc.tick()
+                if rec["decision"] == "up":
+                    saw_up = True
+                    surge.clear()
+                    assert rec["reason"].startswith("spawned slot 1")
+                if (rec["decision"] == "hold"
+                        and rec["n_spawning"] == 1):
+                    saw_warming_hold = (
+                        "topology change in flight" in rec["reason"]
+                        or saw_warming_hold
+                    )
+                    # Backpressure honesty while capacity warms: the
+                    # ETA is published, so sheds answer "retry when
+                    # the new replica can admit".
+                    assert rec["eta_published"] is True
+                    if not probed:
+                        probed = True
+                        st_before = sup.handle(1).state
+                        with router._lock:
+                            rid = router._next_id
+                        pr = router.submit(img, img).result(
+                            timeout=180
+                        )
+                        all_responses.append(pr)
+                        if (pr.status == "ok"
+                                and st_before == SPAWNING):
+                            # READY gate: cold capacity never takes
+                            # traffic before its warmed executable
+                            # set is advertised.
+                            assert router.replica_of(rid) != 1
+                if sc.report()["scale_ups_completed"] >= 1:
+                    break
+                time.sleep(0.2)
+            stop_load.set()
+            lt.join(timeout=10)
+
+            rep = sc.report()
+            assert saw_up and rep["scale_ups"] == 1
+            assert rep["scale_ups_completed"] == 1
+            assert rep["failed_scale_ups"] == 0
+            assert rep["breaker_open"] is False
+            assert rep["time_to_ready_observed"] == 1
+            assert rep["time_to_ready_s"] > 0
+            assert sup.handle(1).state == UP
+
+            # Every load-step submission is terminal: ok or an honest
+            # shed (with the warming ETA floor), never lost.
+            shed_hints = []
+            n_ok = n_shed = 0
+            for h in load_handles:
+                r = h.result(timeout=300)
+                all_responses.append(r)
+                if r.status == "ok":
+                    n_ok += 1
+                elif r.status == "shed":
+                    n_shed += 1
+                    shed_hints.append(r.retry_after_s)
+                else:
+                    raise AssertionError(f"lost/errored: {r}")
+            assert n_ok + n_shed == len(load_handles)
+            assert n_ok >= 1
+            assert any(
+                hint >= cfg.scale_eta_prior_s for hint in shed_hints
+            ), (
+                "no shed carried the time-to-READY floor while "
+                f"capacity warmed: {sorted(shed_hints)[-5:]}"
+            )
+
+            # The re-spawned replica takes traffic once READY.
+            carriers = set()
+            for _ in range(4):
+                with router._lock:
+                    rid = router._next_id
+                r = router.submit(img, img).result(timeout=180)
+                all_responses.append(r)
+                assert r.status == "ok"
+                carriers.add(router.replica_of(rid))
+            assert 1 in carriers
+
+            # ---- phase C: the burst is over — the loop gives the
+            # capacity back (down to the floor), then clears the ETA.
+            # Everything is resolved: no outstanding dispatches anywhere.
+            assert router.inflight_of(0) == 0
+            assert router.inflight_of(1) == 0
+            deadline = time.monotonic() + 150
+            while (time.monotonic() < deadline
+                   and sc.report()["scale_downs"] < 2):
+                sc.tick()
+                time.sleep(0.2)
+            assert sc.report()["scale_downs"] == 2, list(sc.decisions)[-8:]
+            assert [h.index for h in sup.replicas] == [0], (
+                list(sc.decisions)[-8:]
+            )
+            rec = sc.tick()
+            assert rec["eta_published"] is False
+            assert router._scale_eta_s is None
+        finally:
+            sc.stop()
+            router.drain()
+            sup.stop()
+
+        assert all(r.status in TERMINAL_STATUSES for r in all_responses)
+        assert sum(
+            1 for r in all_responses if r.status == "error"
+        ) == 0

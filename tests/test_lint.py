@@ -1160,6 +1160,120 @@ def test_jgl007_fleet_scope_supervisor_must_not_eat_deaths(tmp_path):
     ) == []
 
 
+def test_jgl010_autoscaler_scope_control_loop_is_host_only(tmp_path):
+    """The autoscaler decides fleet topology from healthz dicts and
+    router counters — a control loop that can pull a device array can
+    stall every replica it sizes. fleet/ directory scope covers the
+    new module with zero allowlist entries."""
+    dirty = """
+        import jax
+        import numpy as np
+
+        def occupancy(replica_outputs):
+            flows = [np.asarray(o) for o in replica_outputs]  # pull
+            return float(jax.device_get(flows[0]).mean())
+        """
+    findings = lint_snippet(
+        tmp_path, dirty, name="fleet/autoscaler.py", select=["JGL010"]
+    )
+    assert findings and all(f.rule == "JGL010" for f in findings)
+    clean = """
+        import threading
+        import time
+
+        def tick(handles, router, cfg):
+            ups = [h for h in handles if h.state == "up"]
+            cap = len(ups) * cfg.max_inflight_per_replica
+            used = sum(router.inflight_of(h.index) for h in ups)
+            paging = [
+                p for h in ups
+                for p in ((h.last_healthz or {}).get("slo") or {})
+                .get("paging", [])
+            ]
+            return {"occupancy": used / cap if cap else 1.0,
+                    "paging": paging, "t": time.monotonic()}
+        """
+    assert lint_snippet(
+        tmp_path, clean, name="fleet/autoscaler.py", select=["JGL010"]
+    ) == []
+
+
+def test_jgl007_host_supervisor_must_not_eat_agent_errors(tmp_path):
+    """A manager that silently eats a host agent's RPC failure turns a
+    dead host into a vanished host — the staleness/fencing contract
+    only works if every agent error is counted. JGL007 covers the new
+    host_supervisor module via the fleet/ scope."""
+    dirty = """
+        def poll_hosts(agents):
+            snapshots = {}
+            for host, agent in agents.items():
+                try:
+                    snapshots[host] = agent.call("snapshot")
+                except Exception:
+                    continue  # silent: the host just disappears
+            return snapshots
+        """
+    findings = lint_snippet(
+        tmp_path, dirty, name="fleet/host_supervisor.py",
+        select=["JGL007"],
+    )
+    assert [f.rule for f in findings] == ["JGL007"]
+    accounted = """
+        def poll_hosts(agents, tel, missed):
+            snapshots = {}
+            for host, agent in agents.items():
+                try:
+                    snapshots[host] = agent.call("snapshot")
+                except Exception as e:
+                    missed[host] = missed.get(host, 0) + 1
+                    tel.event("fleet_host_poll_miss", host=host,
+                              error=repr(e))  # counted, never silent
+            return snapshots
+
+        def fence_sock(sock):
+            try:
+                sock.close()
+            except OSError:
+                pass  # narrow: a decided-on drop, out of scope
+        """
+    assert lint_snippet(
+        tmp_path, accounted, name="fleet/host_supervisor.py",
+        select=["JGL007"],
+    ) == []
+
+
+def test_jgl010_host_supervisor_fencing_idioms_are_clean(tmp_path):
+    """The host-supervisor's real vocabulary — signals, /proc reads,
+    wire sockets, healthz JSON — is exactly the host-only shape JGL010
+    protects; the rule must not cry wolf on it."""
+    clean = """
+        import os
+        import signal
+
+        def fence(pids):
+            reaped = []
+            for pid in pids:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                    reaped.append(pid)
+                except ProcessLookupError:
+                    reaped.append(pid)  # already gone counts as fenced
+            return reaped
+
+        def alive(pid):
+            try:
+                with open(f"/proc/{pid}/stat") as fh:
+                    stat = fh.read()
+            except OSError:
+                return False
+            return stat.rpartition(")")[2].split()[0] != "Z"
+        """
+    assert lint_snippet(
+        tmp_path, clean, name="fleet/host_supervisor.py",
+        select=["JGL010"],
+    ) == []
+
+
 # ------------------------------------------------------------ self-check
 
 

@@ -1,0 +1,127 @@
+"""raft_ncup_tpu/traffic.py: the deterministic multi-phase traffic
+generator (first slice of ROADMAP item 4's scenario suite).
+
+Everything here is a replay contract: the elasticity bench, the serve
+bench, and the fleet acceptance tests all consume the SAME schedule, so
+phase attribution, due-time arithmetic, frame determinism, and chaos
+composition are each pinned exactly.
+"""
+
+import numpy as np
+import pytest
+
+from raft_ncup_tpu.resilience.chaos import ChaosSpec
+from raft_ncup_tpu.traffic import StepTraffic, TrafficPhase
+
+
+class TestPhases:
+    def test_step_scenario_bounds(self):
+        t = StepTraffic.step((32, 48))
+        assert t.phase_bounds() == {
+            "low": (0, 8), "high": (8, 32), "cooldown": (32, 40),
+        }
+        assert t.n_requests == 40 and len(t) == 40
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            StepTraffic((32, 48), [])
+        with pytest.raises(ValueError):  # duplicate names
+            StepTraffic((32, 48), [
+                TrafficPhase("a", 1, 0.1), TrafficPhase("a", 1, 0.1),
+            ])
+        with pytest.raises(ValueError):
+            TrafficPhase("a", -1, 0.1)
+        with pytest.raises(ValueError):
+            TrafficPhase("a", 1, -0.1)
+
+    def test_due_times_accumulate_across_phases(self):
+        """A step is a rate CHANGE at an instant, not a gap: phase k+1's
+        first arrival is one of ITS intervals after phase k's last."""
+        t = StepTraffic((32, 48), [
+            TrafficPhase("low", 2, 0.5),
+            TrafficPhase("high", 3, 0.1),
+        ])
+        dues = [item.due_s for item in t.schedule()]
+        assert dues == pytest.approx([0.5, 1.0, 1.1, 1.2, 1.3])
+        assert dues == sorted(dues)
+
+    def test_phase_attribution_matches_bounds(self):
+        t = StepTraffic.step((32, 48), low_n=2, high_n=3)
+        bounds = t.phase_bounds()
+        for item in t.schedule():
+            lo, hi = bounds[item.phase]
+            assert lo <= item.index < hi
+
+
+class TestDeterminism:
+    def test_same_seed_same_bytes_and_schedule(self):
+        a = list(StepTraffic.step((32, 48), low_n=2, high_n=2, seed=7)
+                 .schedule())
+        b = list(StepTraffic.step((32, 48), low_n=2, high_n=2, seed=7)
+                 .schedule())
+        for x, y in zip(a, b):
+            assert (x.index, x.phase, x.due_s) == (y.index, y.phase,
+                                                   y.due_s)
+            np.testing.assert_array_equal(x.image1, y.image1)
+            np.testing.assert_array_equal(x.image2, y.image2)
+
+    def test_different_seed_different_bytes(self):
+        a = next(iter(StepTraffic.step((32, 48), seed=0).schedule()))
+        b = next(iter(StepTraffic.step((32, 48), seed=1).schedule()))
+        assert not np.array_equal(a.image1, b.image1)
+
+
+class TestChaosComposition:
+    def test_burst_multiplies_one_global_index(self):
+        t = StepTraffic(
+            (32, 48),
+            [TrafficPhase("low", 2, 0.1), TrafficPhase("high", 3, 0.1)],
+            chaos=ChaosSpec.parse("burst@3"), burst_size=4,
+        )
+        items = list(t.schedule())
+        # Request 3 (in the HIGH phase — index is global) became 4
+        # copies sharing its index, phase, and due time.
+        assert len(items) == len(t) == 5 + 3
+        copies = [i for i in items if i.index == 3]
+        assert len(copies) == 4
+        assert {(c.phase, c.due_s) for c in copies} == {
+            (copies[0].phase, copies[0].due_s)
+        }
+        assert copies[0].phase == "high"
+
+    def test_poison_nans_first_frame_only(self):
+        t = StepTraffic(
+            (32, 48), [TrafficPhase("low", 3, 0.1)],
+            chaos=ChaosSpec.parse("poison@1"),
+        )
+        items = list(t.schedule())
+        assert np.isnan(items[1].image1).all()
+        assert np.isfinite(items[1].image2).all()
+        assert np.isfinite(items[0].image1).all()
+
+    def test_out_of_range_burst_is_inert(self):
+        t = StepTraffic(
+            (32, 48), [TrafficPhase("low", 2, 0.1)],
+            chaos=ChaosSpec.parse("burst@99"), burst_size=4,
+        )
+        assert len(t) == 2 == len(list(t.schedule()))
+
+
+class TestConsumptionShapes:
+    def test_iter_matches_serving_replay_contract(self):
+        t = StepTraffic.step((32, 48), low_n=2, high_n=2)
+        triples = list(t)
+        rich = list(t.schedule())
+        assert len(triples) == len(rich)
+        for (due, i1, i2), item in zip(triples, rich):
+            assert due == item.due_s
+            np.testing.assert_array_equal(i1, item.image1)
+            assert i1.shape == (32, 48, 3)
+
+    def test_items_matches_replay_fleet_contract(self):
+        t = StepTraffic.step((32, 48), low_n=2, high_n=2)
+        for d in t.items():
+            assert set(d) == {
+                "image1", "image2", "due_s", "phase", "index",
+            }
+            assert isinstance(d["image1"], np.ndarray)
