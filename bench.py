@@ -38,6 +38,16 @@ import os
 import sys
 import time
 
+from raft_ncup_tpu.utils.knobs import (
+    knob_enabled,
+    knob_flag,
+    knob_float,
+    knob_int,
+    knob_positive_int,
+    knob_raw,
+    knob_str,
+)
+
 _CHILD_ENV = "_RAFT_NCUP_BENCH_CHILD"
 _VAL_CHILD_ENV = "_RAFT_NCUP_BENCH_VAL_CHILD"
 _REPO = os.path.dirname(os.path.abspath(__file__))
@@ -51,7 +61,7 @@ SMALL = dict(batch=1, height=96, width=128, iters=4)
 
 # Budget arithmetic: the driver's window is ~900s; keep the whole chain
 # inside TOTAL_BUDGET_S and always reserve the CPU fallback's slice.
-TOTAL_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "840"))
+TOTAL_BUDGET_S = knob_float("BENCH_BUDGET_S")
 PROBE_TIMEOUT_S = 75.0
 TPU_TIMEOUT_CAP_S = 420.0
 CPU_RESERVE_S = 280.0
@@ -109,13 +119,13 @@ def _child_main() -> None:
     from raft_ncup_tpu.utils.profiling import measure_throughput_detailed
 
     shape = json.loads(os.environ.get("_BENCH_SHAPE") or json.dumps(FULL))
-    corr_impl = os.environ.get("BENCH_CORR_IMPL", "volume")
-    nconv_impl = os.environ.get("RAFT_NCUP_NCONV_IMPL", "xla")
+    corr_impl = knob_str("BENCH_CORR_IMPL")
+    nconv_impl = knob_str("RAFT_NCUP_NCONV_IMPL")
     platform = jax.devices()[0].platform
     if (
         platform == "cpu"
         and shape == FULL
-        and os.environ.get("BENCH_ALLOW_FULL_ON_CPU") != "1"
+        and not knob_flag("BENCH_ALLOW_FULL_ON_CPU")
     ):
         # Full-res NCUP x12 iters is a TPU workload; on a host-CPU backend
         # record the reduced shape rather than time out recording nothing.
@@ -209,7 +219,7 @@ def _child_main() -> None:
     # time goes (view with TensorBoard's profile plugin / Perfetto).
     from raft_ncup_tpu.utils.profiling import trace
 
-    with trace(os.environ.get("BENCH_TRACE_DIR") or None):
+    with trace(knob_raw("BENCH_TRACE_DIR") or None):
         rate, rep_times = measure_throughput_detailed(
             lambda: forward(variables, img1, img2),
             warmup=2,
@@ -267,8 +277,9 @@ def _child_main() -> None:
         )
         record["compile_ms"] = cost_entry.get("compile_ms")
         record["compiled_memory_stats"] = cost_entry.get("memory_stats")
-    if os.environ.get("BENCH_TRACE_DIR"):
-        record["trace_dir"] = os.environ["BENCH_TRACE_DIR"]
+    trace_dir = knob_raw("BENCH_TRACE_DIR")
+    if trace_dir:
+        record["trace_dir"] = trace_dir
     if nconv_impl == "pallas":
         counts = nconv_mod.dispatch_counts()
         # Mirror corr_pallas_levels: partial fusion (some call sites gated
@@ -311,7 +322,7 @@ def _child_main() -> None:
     # explicitly (the full-shape CPU anchor: a fwd+bwd at 368x768 on a
     # 1-core host would run for tens of minutes).
     remaining = child_budget - (time.monotonic() - t0)
-    if os.environ.get("BENCH_SKIP_TRAIN") == "1":
+    if knob_flag("BENCH_SKIP_TRAIN"):
         pass
     elif remaining > 0.45 * child_budget:
         handles = None
@@ -369,7 +380,7 @@ def _child_main() -> None:
     # only steal compute cores and the comparison measures contention,
     # not pipelining); accelerators leave the host pool free by nature
     # and measure in-process against the inference row's variables.
-    if os.environ.get("BENCH_SKIP_VAL") == "1":
+    if knob_flag("BENCH_SKIP_VAL"):
         pass
     elif child_budget - (time.monotonic() - t0) > 0.12 * child_budget:
         try:
@@ -400,7 +411,7 @@ def _child_main() -> None:
     # state (the per-batch result pull is the sanctioned explicit
     # device_get in the drain worker — the product, not a leak).
     # BENCH_SKIP_SERVE=1 turns it off explicitly.
-    if os.environ.get("BENCH_SKIP_SERVE") == "1":
+    if knob_flag("BENCH_SKIP_SERVE"):
         pass
     elif child_budget - (time.monotonic() - t0) > 0.08 * child_budget:
         try:
@@ -417,7 +428,7 @@ def _child_main() -> None:
     # table and fixed per-batch-size executable set are the recompile-
     # free contract: `stream_recompiles`/`stream_host_transfers` must be
     # 0. BENCH_SKIP_STREAM=1 turns it off explicitly.
-    if os.environ.get("BENCH_SKIP_STREAM") == "1":
+    if knob_flag("BENCH_SKIP_STREAM"):
         pass
     elif child_budget - (time.monotonic() - t0) > 0.08 * child_budget:
         try:
@@ -435,7 +446,7 @@ def _child_main() -> None:
     # contract at teardown. Spawns processes (each pays its own model
     # warmup), so it rides a generous budget gate;
     # BENCH_SKIP_FLEET=1 turns it off explicitly.
-    if os.environ.get("BENCH_SKIP_FLEET") == "1":
+    if knob_flag("BENCH_SKIP_FLEET"):
         pass
     elif child_budget - (time.monotonic() - t0) > 0.3 * child_budget:
         try:
@@ -454,7 +465,7 @@ def _child_main() -> None:
     # fleet row's steady-state discipline). Spawns processes and rides
     # out a spawn compile, hence the generous gate;
     # BENCH_SKIP_ELASTICITY=1 turns it off explicitly.
-    if os.environ.get("BENCH_SKIP_ELASTICITY") == "1":
+    if knob_flag("BENCH_SKIP_ELASTICITY"):
         pass
     elif child_budget - (time.monotonic() - t0) > 0.3 * child_budget:
         try:
@@ -475,7 +486,7 @@ def _child_main() -> None:
     # BENCH_SKIP_BF16=1 turns the whole block off explicitly. On CPU
     # bf16 is emulated (slower, parity still meaningful); the rows are
     # first in line for real numbers when a chip answers.
-    if os.environ.get("BENCH_SKIP_BF16") == "1":
+    if knob_flag("BENCH_SKIP_BF16"):
         pass
     elif child_budget - (time.monotonic() - t0) > 0.3 * child_budget:
         try:
@@ -515,7 +526,7 @@ def _child_main() -> None:
             ("serve", "BENCH_SKIP_SERVE", _measure_serve),
             ("stream", "BENCH_SKIP_STREAM", _measure_stream),
         ):
-            if os.environ.get(skip_env) == "1":
+            if knob_flag(skip_env):
                 continue
             if child_budget - (time.monotonic() - t0) < 0.1 * child_budget:
                 break
@@ -529,7 +540,7 @@ def _child_main() -> None:
         # bf16_train loop last: it pays a second fwd+bwd compile, the
         # most expensive item in the block.
         if (
-            os.environ.get("BENCH_SKIP_TRAIN") != "1"
+            not knob_flag("BENCH_SKIP_TRAIN")
             and child_budget - (time.monotonic() - t0) > 0.25 * child_budget
         ):
             try:
@@ -563,7 +574,7 @@ def _child_main() -> None:
     # leftover budget — a 1080p compile + reps must never starve the
     # established rows); reduced iters on CPU; BENCH_SKIP_HIGHRES=1
     # turns it off explicitly, BENCH_MESH="data,spatial" pins the mesh.
-    if os.environ.get("BENCH_SKIP_HIGHRES") == "1":
+    if knob_flag("BENCH_SKIP_HIGHRES"):
         pass
     elif child_budget - (time.monotonic() - t0) > 0.12 * child_budget:
         try:
@@ -574,7 +585,7 @@ def _child_main() -> None:
         # bf16 composition (ROADMAP item 3's folded follow-up): the same
         # sharded window under the bf16_infer preset.
         if (
-            os.environ.get("BENCH_SKIP_BF16") != "1"
+            not knob_flag("BENCH_SKIP_BF16")
             and child_budget - (time.monotonic() - t0) > 0.12 * child_budget
         ):
             try:
@@ -589,7 +600,7 @@ def _child_main() -> None:
     # tier makes servable, guarded like the highres row. Very last in
     # budget order — a 4K compile must never starve anything else;
     # BENCH_SKIP_UHD=1 turns it off, BENCH_UHD_* tune shape/iters/reps.
-    if os.environ.get("BENCH_SKIP_UHD") == "1":
+    if knob_flag("BENCH_SKIP_UHD"):
         pass
     elif child_budget - (time.monotonic() - t0) > 0.12 * child_budget:
         try:
@@ -604,7 +615,7 @@ def _child_main() -> None:
     # handoff fingerprint, per-segment ledger costs, and the standard
     # guard counters. Budget-gated like the other tail rows;
     # BENCH_SKIP_PIPELINE=1 turns it off explicitly.
-    if os.environ.get("BENCH_SKIP_PIPELINE") == "1":
+    if knob_flag("BENCH_SKIP_PIPELINE"):
         pass
     elif child_budget - (time.monotonic() - t0) > 0.12 * child_budget:
         try:
@@ -639,7 +650,7 @@ def _measure_bf16_forward(
     from raft_ncup_tpu.precision import FORWARD_EPE_BUDGET
     from raft_ncup_tpu.utils.profiling import measure_throughput_detailed
 
-    strict = os.environ.get("BENCH_STRICT_GUARDS") == "1"
+    strict = knob_flag("BENCH_STRICT_GUARDS")
     iters = shape["iters"]
     model = get_model(
         flagship_config(
@@ -776,8 +787,8 @@ def _measure_train_loop(handles: dict, steps: int | None = None) -> dict:
 
     step, krng = handles["step"], handles["krng"]
     B, H, W = handles["B"], handles["H"], handles["W"]
-    steps = steps or int(os.environ.get("BENCH_TRAIN_LOOP_STEPS", "6"))
-    strict = os.environ.get("BENCH_STRICT_GUARDS") == "1"
+    steps = steps or knob_int("BENCH_TRAIN_LOOP_STEPS")
+    strict = knob_flag("BENCH_STRICT_GUARDS")
 
     rng = np.random.default_rng(11)
 
@@ -899,12 +910,12 @@ def _measure_val_loop(
 
     B, H, W = shape["batch"], shape["height"], shape["width"]
     iters = shape["iters"]
-    n_batches = n_batches or int(os.environ.get("BENCH_VAL_LOOP_BATCHES", "8"))
+    n_batches = n_batches or knob_int("BENCH_VAL_LOOP_BATCHES")
     # Batch 0 of every window is the untimed warm step, so the timed
     # region needs at least one more batch to exist.
     n_batches = max(2, n_batches)
-    reps = int(os.environ.get("BENCH_VAL_LOOP_REPS", "5"))
-    strict = os.environ.get("BENCH_STRICT_GUARDS") == "1"
+    reps = knob_int("BENCH_VAL_LOOP_REPS")
+    strict = knob_flag("BENCH_STRICT_GUARDS")
 
     model = get_model(
         flagship_config(
@@ -1025,7 +1036,7 @@ def _parse_mesh_env() -> tuple | None:
     ``--mesh``): validated positive int pair or None, bad specs loudly
     ignored. Every mesh-aware row goes through this — three hand-rolled
     parsers would mean three divergent failure modes."""
-    spec = os.environ.get("BENCH_MESH")
+    spec = knob_raw("BENCH_MESH")
     if not spec:
         return None
     try:
@@ -1107,15 +1118,15 @@ def _measure_serve(
 
     B, H, W = shape["batch"], shape["height"], shape["width"]
     iters = shape["iters"]
-    n = n_requests or int(os.environ.get("BENCH_SERVE_REQUESTS", "16"))
-    strict = os.environ.get("BENCH_STRICT_GUARDS") == "1"
+    n = n_requests or knob_int("BENCH_SERVE_REQUESTS")
+    strict = knob_flag("BENCH_STRICT_GUARDS")
     # Telemetry-off comparison window (the observer-overhead row;
     # docs/OBSERVABILITY.md methodology). BENCH_SKIP_TELEMETRY_COMPARE=1
     # skips it (fields absent); the bf16 twin skips it too — the
     # observer-overhead question is precision-independent and the f32
     # row already answers it.
     tel_compare = (
-        os.environ.get("BENCH_SKIP_TELEMETRY_COMPARE") != "1"
+        not knob_flag("BENCH_SKIP_TELEMETRY_COMPARE")
         and precision == "f32"
     )
 
@@ -1353,9 +1364,9 @@ def _measure_stream(
 
     B, H, W = shape["batch"], shape["height"], shape["width"]
     iters = shape["iters"]
-    n_streams = int(os.environ.get("BENCH_STREAM_STREAMS", "4"))
-    frames = n_frames or int(os.environ.get("BENCH_STREAM_FRAMES", "6"))
-    strict = os.environ.get("BENCH_STRICT_GUARDS") == "1"
+    n_streams = knob_int("BENCH_STREAM_STREAMS")
+    frames = n_frames or knob_int("BENCH_STREAM_FRAMES")
+    strict = knob_flag("BENCH_STRICT_GUARDS")
 
     cfg = StreamConfig(
         capacity=n_streams,
@@ -1497,8 +1508,8 @@ def _measure_fleet(shape: dict, corr_impl: str) -> dict:
 
     H, W = shape["height"], shape["width"]
     iters = shape["iters"]
-    n_replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", "2"))
-    n = int(os.environ.get("BENCH_FLEET_REQUESTS", "12"))
+    n_replicas = knob_int("BENCH_FLEET_REPLICAS")
+    n = knob_int("BENCH_FLEET_REQUESTS")
     platform = os.environ.get("_BENCH_FORCE_PLATFORM") or "cpu"
 
     import tempfile
@@ -1569,7 +1580,7 @@ def _measure_fleet(shape: dict, corr_impl: str) -> dict:
         # by flip_recommendations). BENCH_SKIP_TELEMETRY_COMPARE=1
         # skips it.
         responses_off, dt_off = [], None
-        if os.environ.get("BENCH_SKIP_TELEMETRY_COMPARE") != "1":
+        if not knob_flag("BENCH_SKIP_TELEMETRY_COMPARE"):
             acked = router.set_fleet_telemetry(False, timeout=15.0)
             tel.enabled = False
             try:
@@ -1722,9 +1733,9 @@ def _measure_elasticity(shape: dict, corr_impl: str) -> dict:
 
     H, W = shape["height"], shape["width"]
     iters = shape["iters"]
-    low_n = int(os.environ.get("BENCH_ELASTICITY_LOW", "4"))
-    high_n = int(os.environ.get("BENCH_ELASTICITY_HIGH", "48"))
-    grace_s = float(os.environ.get("BENCH_ELASTICITY_GRACE_S", "120"))
+    low_n = knob_int("BENCH_ELASTICITY_LOW")
+    high_n = knob_int("BENCH_ELASTICITY_HIGH")
+    grace_s = knob_float("BENCH_ELASTICITY_GRACE_S")
     platform = os.environ.get("_BENCH_FORCE_PLATFORM") or "cpu"
 
     import tempfile
@@ -1965,19 +1976,15 @@ def _measure_highres(variables: dict, precision: str = "f32") -> dict:
     platform = jax.devices()[0].platform
     H, W = (
         int(x)
-        for x in os.environ.get("BENCH_HIGHRES_SIZE", "1088,1920").split(",")
+        for x in knob_str("BENCH_HIGHRES_SIZE").split(",")
     )
-    iters = int(
-        os.environ.get(
-            "BENCH_HIGHRES_ITERS", "32" if platform != "cpu" else "2"
-        )
+    iters = knob_int(
+        "BENCH_HIGHRES_ITERS", default="32" if platform != "cpu" else "2"
     )
-    reps = int(
-        os.environ.get(
-            "BENCH_HIGHRES_REPS", "3" if platform != "cpu" else "2"
-        )
+    reps = knob_int(
+        "BENCH_HIGHRES_REPS", default="3" if platform != "cpu" else "2"
     )
-    strict = os.environ.get("BENCH_STRICT_GUARDS") == "1"
+    strict = knob_flag("BENCH_STRICT_GUARDS")
 
     devices = jax.devices()
     spec = _parse_mesh_env()
@@ -2070,7 +2077,7 @@ def _measure_highres(variables: dict, precision: str = "f32") -> dict:
         "highres_recompiles": main_w["recompiles"],
         "highres_host_transfers": main_w["host_transfers"],
     }
-    if mesh is not None and os.environ.get("BENCH_HIGHRES_COMPARE") != "0":
+    if mesh is not None and knob_enabled("BENCH_HIGHRES_COMPARE"):
         ref = window(None)
         row["highres_pairs_per_sec_unsharded"] = ref["pairs_per_sec"]
         row["highres_analysis_temp_gib_unsharded"] = ref["temp_gib"]
@@ -2125,16 +2132,14 @@ def _measure_uhd(variables: dict, precision: str = "f32") -> dict:
     on_accel = platform != "cpu"
     H, W = (
         int(x)
-        for x in os.environ.get("BENCH_UHD_SIZE", "2176,3840").split(",")
+        for x in knob_str("BENCH_UHD_SIZE").split(",")
     )
-    iters = int(
-        os.environ.get("BENCH_UHD_ITERS", "32" if on_accel else "1")
+    iters = knob_int("BENCH_UHD_ITERS", default="32" if on_accel else "1")
+    reps = knob_int("BENCH_UHD_REPS", default="3" if on_accel else "2")
+    corr_impl = knob_str(
+        "BENCH_UHD_CORR", default="pallas" if on_accel else "onthefly"
     )
-    reps = int(os.environ.get("BENCH_UHD_REPS", "3" if on_accel else "2"))
-    corr_impl = os.environ.get(
-        "BENCH_UHD_CORR", "pallas" if on_accel else "onthefly"
-    )
-    strict = os.environ.get("BENCH_STRICT_GUARDS") == "1"
+    strict = knob_flag("BENCH_STRICT_GUARDS")
 
     model = get_model(
         flagship_config(
@@ -2245,25 +2250,23 @@ def _measure_pipeline(variables: dict) -> dict:
 
     platform = jax.devices()[0].platform
     n_dev = len(jax.devices())
-    env_s = os.environ.get("BENCH_PIPELINE_SEGMENTS")
-    if env_s:
-        segments = int(env_s)
+    env_segments = knob_positive_int("BENCH_PIPELINE_SEGMENTS")
+    if env_segments:
+        segments = env_segments
     else:
         segments = next((s for s in (4, 2) if s <= n_dev), 1)
     H, W = (
         int(x)
-        for x in os.environ.get("BENCH_PIPELINE_SIZE", "256,448").split(",")
+        for x in knob_str("BENCH_PIPELINE_SIZE").split(",")
     )
-    iters = int(
-        os.environ.get(
-            "BENCH_PIPELINE_ITERS", "32" if platform != "cpu" else "4"
-        )
+    iters = knob_int(
+        "BENCH_PIPELINE_ITERS", default="32" if platform != "cpu" else "4"
     )
     # Budgets quantize to segment boundaries (serving/budget.py); so
     # does the bench knob — down, never up (honest about work done).
     iters = max(segments, iters - iters % segments)
-    micro = int(os.environ.get("BENCH_PIPELINE_BATCHES", str(2 * segments)))
-    strict = os.environ.get("BENCH_STRICT_GUARDS") == "1"
+    micro = knob_int("BENCH_PIPELINE_BATCHES", default=str(2 * segments))
+    strict = knob_flag("BENCH_STRICT_GUARDS")
 
     model = get_model(flagship_config(dataset="sintel", corr_impl="onthefly"))
     rng = np.random.default_rng(11)
@@ -2323,7 +2326,7 @@ def _measure_pipeline(variables: dict) -> dict:
         row["pipeline_flops_per_segment"] = led.get("flops_per_segment")
         row["pipeline_bytes_per_segment"] = led.get("bytes_per_segment")
         row["pipeline_tick_compile_ms"] = led.get("compile_ms")
-    if segments > 1 and os.environ.get("BENCH_PIPELINE_COMPARE") != "0":
+    if segments > 1 and knob_enabled("BENCH_PIPELINE_COMPARE"):
         _, ref = window(1)
         row["pipeline_pairs_per_sec_monolithic"] = ref["pairs_per_sec"]
         row["pipeline_recompiles"] += ref["recompiles"]
@@ -2401,7 +2404,7 @@ def _val_child_main() -> None:
     from raft_ncup_tpu.models.raft import get_model
 
     shape = json.loads(os.environ["_BENCH_SHAPE"])
-    corr_impl = os.environ.get("BENCH_CORR_IMPL", "volume")
+    corr_impl = knob_str("BENCH_CORR_IMPL")
     precision = os.environ.get("_BENCH_PRECISION", "f32")
     model = get_model(
         flagship_config(
@@ -2511,7 +2514,7 @@ def main() -> None:
     # row (and any mesh-aware row) runs on. Children inherit it via env
     # BENCH_MESH; on the CPU fallback the product also forces that many
     # virtual host devices so the sharded program can actually execute.
-    ap.add_argument("--mesh", default=os.environ.get("BENCH_MESH"))
+    ap.add_argument("--mesh", default=knob_raw("BENCH_MESH"))
     cli_args, _ = ap.parse_known_args()
     if cli_args.trace_dir:
         os.environ["BENCH_TRACE_DIR"] = os.path.abspath(cli_args.trace_dir)

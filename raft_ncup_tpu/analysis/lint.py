@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import argparse
 import ast
+import json
 import os
 import sys
 from dataclasses import dataclass, field
@@ -239,8 +240,8 @@ def run_lint(
             raise AllowlistError(f"unknown rule id(s): {sorted(unknown)}")
         rules = tuple(RULES_BY_ID[r] for r in sorted(select))
 
-    # Pass 1: parse everything once (axis discovery needs the full set
-    # before any per-module rule runs).
+    # Pass 1: parse everything once (axis discovery and the
+    # whole-program graph need the full set before any rule runs).
     trees: dict = {}
     for path in find_py_files(paths):
         display = path.replace("\\", "/")
@@ -251,6 +252,7 @@ def run_lint(
         except (OSError, SyntaxError) as e:
             result.parse_errors.append((display, str(e)))
             continue
+        attach_parents(tree)
         trees[display] = (tree, collect_aliases(tree))
     result.files_checked = len(trees)
     if declared_axes is not None:
@@ -264,11 +266,22 @@ def run_lint(
             # judged instead of silently skipped.
             result.declared_axes = production_declared_axes()
 
-    # Pass 2: rules.
+    # Pass 2: per-module rules, then whole-program rules once over the
+    # full graph (JGL011+ expose check_project instead of check).
     from raft_ncup_tpu.analysis.astutil import TracedIndex
 
+    module_rules = tuple(r for r in rules if hasattr(r, "check"))
+    project_rules = tuple(r for r in rules if hasattr(r, "check_project"))
+
+    def _record(finding) -> None:
+        entry = next((e for e in entries if e.matches(finding)), None)
+        if entry is not None:
+            entry.used = True
+            result.suppressed.append((finding, entry))
+        else:
+            result.findings.append(finding)
+
     for display, (tree, aliases) in trees.items():
-        attach_parents(tree)
         ctx = ModuleContext(
             path=display,
             tree=tree,
@@ -276,16 +289,17 @@ def run_lint(
             traced=TracedIndex(tree, aliases),
             declared_axes=result.declared_axes,
         )
-        for rule in rules:
+        for rule in module_rules:
             for finding in rule.check(ctx):
-                entry = next(
-                    (e for e in entries if e.matches(finding)), None
-                )
-                if entry is not None:
-                    entry.used = True
-                    result.suppressed.append((finding, entry))
-                else:
-                    result.findings.append(finding)
+                _record(finding)
+
+    if project_rules:
+        from raft_ncup_tpu.analysis.project import ProjectIndex
+
+        proj = ProjectIndex.build(trees)
+        for rule in project_rules:
+            for finding in rule.check_project(proj):
+                _record(finding)
 
     # Staleness is only decidable for entries whose rule actually ran:
     # under --select, an entry for a deselected rule (or a "*" entry) is
@@ -302,6 +316,52 @@ def run_lint(
     return result
 
 
+def render_json(result: LintResult, failed: bool) -> dict:
+    """The ``--format json`` document. STABLE schema (pinned by
+    tests/test_lint.py): CI and future tooling diff lint runs on it, so
+    fields are only ever added, never renamed or removed. Findings are
+    the union of reported and allowlist-suppressed ones, each carrying a
+    ``suppressed`` flag (suppressed entries add the justification)."""
+    findings = [
+        {
+            "rule": f.rule,
+            "path": f.path,
+            "line": f.line,
+            "col": f.col,
+            "qualname": f.qualname,
+            "message": f.message,
+            "suppressed": False,
+        }
+        for f in result.findings
+    ] + [
+        {
+            "rule": f.rule,
+            "path": f.path,
+            "line": f.line,
+            "col": f.col,
+            "qualname": f.qualname,
+            "message": f.message,
+            "suppressed": True,
+            "justification": entry.justification,
+        }
+        for f, entry in result.suppressed
+    ]
+    findings.sort(
+        key=lambda d: (d["path"], d["line"], d["col"], d["rule"])
+    )
+    return {
+        "files_checked": result.files_checked,
+        "findings": findings,
+        "parse_errors": [
+            {"path": p, "message": m} for p, m in result.parse_errors
+        ],
+        "stale_allowlist_entries": [
+            e.render() for e in result.stale_entries
+        ],
+        "exit_code": 1 if failed else 0,
+    }
+
+
 def _print_catalog() -> None:
     print("graftlint rule catalog:")
     for mod in ALL_RULES:
@@ -312,8 +372,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m raft_ncup_tpu.analysis",
         description="graftlint: JAX-aware static analysis enforcing the "
-        "sync-free, recompile-free hot path and honest error handling "
-        "(rules JGL001-JGL010).",
+        "sync-free, recompile-free hot path, honest error handling, and "
+        "the cross-module control-plane contracts — lock discipline, "
+        "wire-protocol keys, the env-knob registry (rules "
+        "JGL001-JGL013).",
     )
     parser.add_argument("paths", nargs="*", default=["raft_ncup_tpu"],
                         help="files/directories to lint (default: the "
@@ -333,6 +395,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--show-suppressed", action="store_true",
                         help="also print allowlisted findings with their "
                         "justifications")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text",
+                        help="output format; 'json' emits one machine-"
+                        "readable document (schema pinned in "
+                        "tests/test_lint.py) for CI diffing")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -347,6 +414,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     except (AllowlistError, FileNotFoundError) as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
+
+    failed = bool(
+        result.findings
+        or result.parse_errors
+        or (args.strict_allowlist and result.stale_entries)
+    )
+
+    if args.format == "json":
+        print(json.dumps(render_json(result, failed), indent=2,
+                         sort_keys=True))
+        return 1 if failed else 0
 
     for path, msg in result.parse_errors:
         print(f"{path}: parse error: {msg}")
@@ -363,11 +441,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             file=stream,
         )
 
-    failed = bool(
-        result.findings
-        or result.parse_errors
-        or (args.strict_allowlist and result.stale_entries)
-    )
     print(
         f"graftlint: {result.files_checked} files, "
         f"{len(result.findings)} finding(s), "

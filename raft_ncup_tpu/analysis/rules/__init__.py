@@ -1,10 +1,12 @@
 """graftlint rule registry — one module per JGL rule.
 
-Each rule module exposes ``RULE_ID``, ``SUMMARY`` and
-``check(ctx: ModuleContext) -> Iterator[Finding]``. Adding a rule means
-adding a module here and listing it in ``ALL_RULES``; the engine, CLI
-``--select`` filtering, catalog output and tests pick it up from the
-registry.
+Per-module rules expose ``RULE_ID``, ``SUMMARY`` and
+``check(ctx: ModuleContext) -> Iterator[Finding]``; whole-program rules
+(JGL011+) expose ``check_project(proj: ProjectIndex)`` instead and run
+once over the cross-module graph after the per-module pass. Adding a
+rule means adding a module here and listing it in ``ALL_RULES``; the
+engine, CLI ``--select`` filtering, catalog output and tests pick it up
+from the registry.
 """
 
 from __future__ import annotations
@@ -20,6 +22,9 @@ from raft_ncup_tpu.analysis.rules import (
     jgl008_eval_loop_pulls,
     jgl009_precision_policy,
     jgl010_telemetry_isolation,
+    jgl011_lock_discipline,
+    jgl012_wire_contract,
+    jgl013_env_knobs,
 )
 
 ALL_RULES = (
@@ -33,6 +38,9 @@ ALL_RULES = (
     jgl008_eval_loop_pulls,
     jgl009_precision_policy,
     jgl010_telemetry_isolation,
+    jgl011_lock_discipline,
+    jgl012_wire_contract,
+    jgl013_env_knobs,
 )
 
 RULES_BY_ID = {mod.RULE_ID: mod for mod in ALL_RULES}

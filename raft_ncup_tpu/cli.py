@@ -17,8 +17,9 @@ from __future__ import annotations
 
 import argparse
 import ast
-import os
 from typing import Optional, Sequence
+
+from raft_ncup_tpu.utils.knobs import knob_raw
 
 from raft_ncup_tpu.config import (
     DataConfig,
@@ -116,7 +117,7 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
 
 def add_platform_arg(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
-        "--platform", default=os.environ.get("RAFT_NCUP_PLATFORM"),
+        "--platform", default=knob_raw("RAFT_NCUP_PLATFORM"),
         help="force the jax platform (e.g. 'cpu', 'tpu'). The container's "
         "boot hook bakes its accelerator platform into jax.config at "
         "interpreter start — env JAX_PLATFORMS alone cannot override it, "
@@ -395,7 +396,7 @@ def add_train_args(parser: argparse.ArgumentParser) -> None:
                         help="consecutive bad steps that halt the run "
                         "(exit code 76, rollback to last good checkpoint)")
     parser.add_argument("--chaos",
-                        default=os.environ.get("RAFT_NCUP_CHAOS"),
+                        default=knob_raw("RAFT_NCUP_CHAOS"),
                         help="deterministic fault injection for resilience "
                         "tests: comma-joined nan@STEP / ioerror@READ / "
                         "sigterm@STEP (resilience/chaos.py; env fallback "

@@ -161,6 +161,8 @@ class FleetAutoscaler:
                         burn_fast, float(v.get("burn_fast") or 0.0)
                     )
         shed_total = int(self.router.stats.get("shed", 0))
+        with self._lock:  # RLock: tick() calls this holding it already
+            last_shed = self._last_shed
         return {
             "n_up": len(ups),
             "n_spawning": len(spawning),
@@ -171,7 +173,7 @@ class FleetAutoscaler:
             "paging": sorted(set(paging)),
             "burn_fast": round(burn_fast, 3),
             "shed_total": shed_total,
-            "shed_delta": shed_total - self._last_shed,
+            "shed_delta": shed_total - last_shed,
         }
 
     # ------------------------------------------------------------ the loop

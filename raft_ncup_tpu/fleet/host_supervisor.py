@@ -215,7 +215,11 @@ class HostSupervisor:
         if kind == "healthz":
             return self.republish()
         if kind == "spawn":
-            i = int(header["index"])
+            raw_index = header.get("index")
+            if raw_index is None:
+                return {"kind": "error", "op": "spawn",
+                        "error": "spawn frame missing 'index'"}
+            i = int(raw_index)
             try:
                 self.sup.add_replica(i, wait_ready=False)
                 return {"kind": "ok", "op": "spawn", "index": i}
@@ -226,7 +230,11 @@ class HostSupervisor:
                 return {"kind": "error", "op": "spawn", "index": i,
                         "error": f"slot not in manifest: {e!r}"}
         if kind == "drain":
-            i = int(header["index"])
+            raw_index = header.get("index")
+            if raw_index is None:
+                return {"kind": "error", "op": "drain",
+                        "error": "drain frame missing 'index'"}
+            i = int(raw_index)
             try:
                 result = self.sup.remove_replica(i, drain=True)
                 return {"kind": "ok", "op": "drain", "index": i,
@@ -373,7 +381,8 @@ class FleetManager:
         deadline = time.monotonic() + (
             self.cfg.spawn_timeout_s if timeout is None else timeout
         )
-        pending = {h.index for h in self.replicas}
+        with self._lock:
+            pending = {h.index for h in self.replicas}
         while pending:
             for host in self.cfg.hosts:
                 agent = self.agents.get(host)
@@ -427,8 +436,9 @@ class FleetManager:
             return None
 
     def _poll_host(self, host: str) -> None:
-        if host in self._dead_hosts:
-            return
+        with self._lock:
+            if host in self._dead_hosts:
+                return
         reply = self._agent_call(host, {"kind": "healthz"})
         now = time.monotonic()
         if reply is not None and reply.get("kind") == "healthz":
@@ -643,8 +653,10 @@ class FleetManager:
         if self._poll_thread is not None and self._poll_thread.is_alive():
             self._poll_thread.join(timeout=10.0)
         results: Dict[str, Optional[dict]] = {}
+        with self._lock:
+            dead_hosts = set(self._dead_hosts)
         for host, agent in self.agents.items():
-            if host not in self._dead_hosts and drain:
+            if host not in dead_hosts and drain:
                 results[host] = self._agent_call(
                     host, {"kind": "stop"},
                     timeout_s=self.cfg.drain_timeout_s,
@@ -673,10 +685,11 @@ class FleetManager:
         with self._lock:
             snaps = [h.snapshot() for h in self.replicas]
             retired = [h.snapshot() for h in self.retired]
+            dead_hosts = sorted(self._dead_hosts)
         return {
             "replicas": snaps,
             "retired": retired,
-            "dead_hosts": sorted(self._dead_hosts),
+            "dead_hosts": dead_hosts,
             "partitioned_hosts": sorted(self._partitioned),
             "deaths": sum(s["deaths"] for s in snaps + retired),
             "stale_deaths": sum(

@@ -387,7 +387,8 @@ class ReplicaSupervisor:
         deadline = time.monotonic() + (
             self.cfg.spawn_timeout_s if timeout is None else timeout
         )
-        pending = {h.index for h in self.replicas}
+        with self._lock:
+            pending = {h.index for h in self.replicas}
         while pending:
             for i in sorted(pending):
                 handle = self.handle(i)
@@ -699,7 +700,9 @@ class ReplicaSupervisor:
         if self._poll_thread is not None and self._poll_thread.is_alive():
             self._poll_thread.join(timeout=10.0)
         reports: Dict[int, dict] = {}
-        for handle in self.replicas:
+        with self._lock:
+            handles = list(self.replicas)
+        for handle in handles:
             if handle.state in (UP, SPAWNING) and drain:
                 self.drain(handle.index)
             child = handle.child

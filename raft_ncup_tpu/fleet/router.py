@@ -166,7 +166,10 @@ class _Link:
                 self._on_message(self.index, *msg)
         except (OSError, ValueError):
             pass  # connection torn mid-frame: same as EOF below
-        self.alive = False
+        with self.send_lock:
+            # Same lock as send(): a sender mid-send must never observe
+            # alive flipping under it (JGL011).
+            self.alive = False
         try:
             self.sock.close()
         except OSError:
@@ -319,13 +322,14 @@ class FleetRouter:
         during a cold compile just re-sheds; a client told "retry in
         the ETA" lands on the new capacity (regression-pinned in
         tests/test_fleet.py)."""
-        hints = [
-            self._shed_hints[i] for i in consulted
-            if i in self._shed_hints
-        ]
-        floor = [self.cfg.default_retry_after_s]
-        if self._scale_eta_s is not None:
-            floor.append(self._scale_eta_s)
+        with self._lock:  # RLock: callers may already hold it
+            hints = [
+                self._shed_hints[i] for i in consulted
+                if i in self._shed_hints
+            ]
+            floor = [self.cfg.default_retry_after_s]
+            if self._scale_eta_s is not None:
+                floor.append(self._scale_eta_s)
         return round(max(hints + floor), 4)
 
     def _link(self, i: int) -> Optional[_Link]:
@@ -452,10 +456,12 @@ class FleetRouter:
         # ignore it; the JGL010 wire-compat check keeps it optional).
         now = self._clock()
         pending.sent_s = now
+        with self._lock:
+            clock_offset_s = self._clock_offsets.get(target, 0.0)
         pending.header["trace"] = TraceContext(
             trace_id=pending.trace_id,
             span_id=f"router-{pending.rid}",
-            clock_offset_s=self._clock_offsets.get(target, 0.0),
+            clock_offset_s=clock_offset_s,
             sent_s=now,
         ).to_wire()
         self._tel.event(
@@ -479,7 +485,8 @@ class FleetRouter:
             self._on_replica_death(target, "dispatch send failed")
 
     def _complete_shed(self, rid, handle, consulted, detail) -> None:
-        self.stats["shed"] += 1
+        with self._lock:
+            self.stats["shed"] += 1
         self._tel.inc("fleet_shed_total")
         handle.complete(FlowResponse(
             rid, STATUS_SHED,
@@ -550,15 +557,17 @@ class FleetRouter:
                     for i in pending.consulted | {index}
                     if i in self._shed_hints
                 ]
+                self.stats["shed"] += 1
             retry_after = round(max(
                 hints + [float(retry_after or 0.0),
                          self.cfg.default_retry_after_s]
             ), 4)
-            self.stats["shed"] += 1
             self._tel.inc("fleet_shed_total")
         now = self._clock()
         flow = arrays[0] if arrays else None
-        self.stats["completed"] += 1
+        with self._lock:
+            self.stats["completed"] += 1
+            offset = self._clock_offsets.get(pending.replica, 0.0)
         self._tel.hist_observe(
             "fleet_e2e_ms", (now - pending.submit_time) * 1e3
         )
@@ -567,7 +576,6 @@ class FleetRouter:
         # its own monotonic clock; the handshake offset translates them
         # onto the router's. Clamped at 0 — the offset carries up to
         # rtt/2 of estimation error, and a hop must never read negative.
-        offset = self._clock_offsets.get(pending.replica, 0.0)
         t_recv = header.get("t_recv_s")
         t_done = header.get("t_done_s")
         if t_recv is not None and pending.sent_s is not None:
@@ -672,7 +680,8 @@ class FleetRouter:
 
     def _failover_one(self, p: _Pending, dead: int, now: float) -> None:
         if p.failovers >= self.cfg.max_failovers:
-            self.stats["failover_errors"] += 1
+            with self._lock:
+                self.stats["failover_errors"] += 1
             p.handle.complete(FlowResponse(
                 p.rid, STATUS_ERROR,
                 detail=f"replica {dead} died; failover budget "
@@ -680,7 +689,8 @@ class FleetRouter:
             ))
             return
         if p.deadline is not None and now >= p.deadline:
-            self.stats["failover_errors"] += 1
+            with self._lock:
+                self.stats["failover_errors"] += 1
             p.handle.complete(FlowResponse(
                 p.rid, STATUS_ERROR,
                 latency_s=now - p.submit_time,
@@ -710,7 +720,7 @@ class FleetRouter:
             p.replica = target
             p.consulted |= set(consulted)
             self._register_failover(p, target)
-        self.stats["failovers"] += 1
+            self.stats["failovers"] += 1
         self._tel.inc("fleet_failovers_total")
         self._tel.event(
             "fleet_failover", request_id=p.rid, from_replica=dead,

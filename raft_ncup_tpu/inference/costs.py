@@ -60,6 +60,7 @@ import threading
 from typing import Dict, Optional
 
 from raft_ncup_tpu.utils.flops import TPU_PEAK_FLOPS
+from raft_ncup_tpu.utils.knobs import knob_enabled, knob_raw
 
 COST_LEDGER_ENV = "RAFT_NCUP_COST_LEDGER"
 CPU_PEAK_ENV = "RAFT_NCUP_CPU_PEAK_FLOPS"
@@ -92,7 +93,7 @@ def peak_flops(
         return None
     backend = backend.lower()
     if backend == "cpu":
-        override = os.environ.get(CPU_PEAK_ENV)
+        override = knob_raw(CPU_PEAK_ENV)
         if override:
             try:
                 return float(override)
@@ -166,7 +167,7 @@ class CostLedger:
 
     def __init__(self, enabled: Optional[bool] = None):
         self.enabled = (
-            os.environ.get(COST_LEDGER_ENV, "1") != "0"
+            knob_enabled(COST_LEDGER_ENV)
             if enabled is None else bool(enabled)
         )
         self._entries: Dict[str, dict] = {}

@@ -31,6 +31,7 @@ import time
 from typing import Callable, Optional
 
 from raft_ncup_tpu.observability.flight import FLIGHT_ENV, FlightRecorder
+from raft_ncup_tpu.utils.knobs import knob_enabled, knob_raw
 from raft_ncup_tpu.observability.health import HealthTracker, overall_state
 from raft_ncup_tpu.observability.spans import (
     NOOP_SPAN,
@@ -181,8 +182,8 @@ def get_telemetry() -> Telemetry:
     with _default_lock:
         if _default is None:
             _default = Telemetry(
-                enabled=os.environ.get(TELEMETRY_ENV, "1") != "0",
-                flight_dir=os.environ.get(FLIGHT_ENV) or None,
+                enabled=knob_enabled(TELEMETRY_ENV),
+                flight_dir=knob_raw(FLIGHT_ENV) or None,
             )
         return _default
 

@@ -28,31 +28,16 @@ A fused Pallas kernel for the lookup lives in
 from __future__ import annotations
 
 import math
-import os
 from typing import NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp
 
 from raft_ncup_tpu.ops.geometry import avg_pool2, grid_sample
+from raft_ncup_tpu.utils.knobs import knob_positive_int
 
 ROW_CHUNK_ENV = "RAFT_NCUP_CORR_ROW_CHUNK"
 _DEFAULT_ROW_CHUNK = 8
-
-
-def _env_int(name: str) -> int | None:
-    """Positive-int env knob parse, shared by every correlation tuning
-    knob (this module's row_chunk; corr_pallas's query_block /
-    band_rows): unset, non-int, or non-positive all mean "no
-    override"."""
-    raw = os.environ.get(name)
-    if not raw:
-        return None
-    try:
-        v = int(raw)
-    except ValueError:
-        return None
-    return v if v > 0 else None
 
 
 def effective_row_chunk() -> int:
@@ -64,7 +49,7 @@ def effective_row_chunk() -> int:
     the choice behind a warmed executable is visible to
     ``scripts/flip_recommendations.py`` and ROADMAP item 1's
     autotuner."""
-    return _env_int(ROW_CHUNK_ENV) or _DEFAULT_ROW_CHUNK
+    return knob_positive_int(ROW_CHUNK_ENV) or _DEFAULT_ROW_CHUNK
 
 
 def corr_tuning_meta() -> dict:

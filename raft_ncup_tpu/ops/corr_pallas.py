@@ -104,7 +104,7 @@ except ImportError:  # pragma: no cover - CPU-only jax builds
     pltpu = None
     _SMEM = None
 
-from raft_ncup_tpu.ops.corr import _env_int
+from raft_ncup_tpu.utils.knobs import knob_positive_int
 from raft_ncup_tpu.utils.runtime import VMEM_BYTES as _VMEM_BYTES
 
 _QUERY_BLOCK = 512
@@ -119,14 +119,14 @@ def effective_query_block() -> int:
     ``RAFT_NCUP_CORR_QUERY_BLOCK`` override when set, else 512. A
     tuning knob (ROADMAP item 1): smaller blocks shrink the
     double-buffered block term of the VMEM budget, buying band rows."""
-    return _env_int(QUERY_BLOCK_ENV) or _QUERY_BLOCK
+    return knob_positive_int(QUERY_BLOCK_ENV) or _QUERY_BLOCK
 
 
 def band_rows_override() -> int | None:
     """``RAFT_NCUP_CORR_BAND_ROWS`` when set (an expert/autotuner knob:
     it wins over :func:`band_plan`'s budget-derived choice), else None
     = auto."""
-    return _env_int(BAND_ROWS_ENV)
+    return knob_positive_int(BAND_ROWS_ENV)
 
 
 def tuning_meta() -> dict:

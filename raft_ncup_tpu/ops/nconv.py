@@ -95,9 +95,9 @@ def nconv2d(
       (out, conf_out), both (B, H', W', Cout); SAME padding for odd kernels
       (reference pads kernel//2, core/nconv_modules.py:143-144).
     """
-    import os
+    from raft_ncup_tpu.utils.knobs import knob_str
 
-    impl = impl or os.environ.get("RAFT_NCUP_NCONV_IMPL", "xla")
+    impl = impl or knob_str("RAFT_NCUP_NCONV_IMPL")
     if impl == "pallas":
         from raft_ncup_tpu.ops import nconv_pallas as npk
 

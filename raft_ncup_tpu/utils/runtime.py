@@ -10,6 +10,8 @@ from __future__ import annotations
 import os
 import sys
 
+from raft_ncup_tpu.utils.knobs import knob_int
+
 # Platform strings that are definitely NOT TPU-class. A denylist, not
 # `backend == "tpu"`: TPU-class plugins report their own platform strings
 # (the axon tunnel does) and must get the real Mosaic compile.
@@ -17,7 +19,7 @@ NON_TPU_BACKENDS = ("cpu", "gpu", "cuda", "rocm")
 
 # Per-core VMEM capacity (~16 MiB on current TPUs —
 # /opt/skills/guides/pallas_guide.md "Memory Hierarchy").
-VMEM_BYTES = int(os.environ.get("RAFT_NCUP_VMEM_BYTES", str(16 * 1024 * 1024)))
+VMEM_BYTES = knob_int("RAFT_NCUP_VMEM_BYTES")
 
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

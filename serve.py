@@ -46,6 +46,8 @@ import sys
 import threading
 import time
 
+from raft_ncup_tpu.utils.knobs import knob_str
+
 
 @contextlib.contextmanager
 def _telemetry_export(args):
@@ -187,8 +189,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "SIGTERM/exit-75 contract; "
                         "docs/OBSERVABILITY.md)")
     parser.add_argument("--flight_dir",
-                        default=os.environ.get(
-                            "RAFT_NCUP_FLIGHT_DIR", "flight_recorder"
+                        default=knob_str(
+                            "RAFT_NCUP_FLIGHT_DIR",
+                            default="flight_recorder",
                         ),
                         help="fault flight-recorder directory: every "
                         "fault trigger (poison quarantine, anomaly "

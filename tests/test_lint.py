@@ -1274,7 +1274,491 @@ def test_jgl010_host_supervisor_fencing_idioms_are_clean(tmp_path):
     ) == []
 
 
+def lint_files(tmp_path, files, select=None):
+    """Multi-file fixture helper for the whole-program rules: write each
+    ``rel_path -> source`` pair under tmp_path and lint the directory."""
+    for rel, source in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+    result = run_lint([str(tmp_path)], select=select)
+    assert not result.parse_errors, result.parse_errors
+    return result.findings
+
+
+# --------------------------------------------------------------- JGL011
+
+
+def test_jgl011_flags_unlocked_read_of_guarded_attr(tmp_path):
+    """An attr written under the class lock in one method and read bare
+    in another is exactly the race the fleet tier keeps hitting — the
+    finding names BOTH sites."""
+    findings = lint_files(
+        tmp_path,
+        {
+            "fleet/reg.py": """
+            import threading
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def add(self, key, value):
+                    with self._lock:
+                        self._items[key] = value
+
+                def peek(self, key):
+                    return self._items.get(key)   # unlocked read
+            """,
+        },
+        select=["JGL011"],
+    )
+    assert [f.rule for f in findings] == ["JGL011"]
+    f = findings[0]
+    assert f.qualname == "peek"
+    assert "Registry._items" in f.message
+    assert "written under the class lock" in f.message
+    assert "[add]" in f.message  # the guarded-write site is named too
+
+
+def test_jgl011_locked_reads_and_always_locked_helpers_clean(tmp_path):
+    """The discipline the fixed fleet code follows is clean: every
+    access under the lock, __init__ exempt, and a private helper whose
+    call sites all hold the lock inherits the guard (the always-locked
+    fixpoint — no false positive on the helper's bare reads)."""
+    findings = lint_files(
+        tmp_path,
+        {
+            "fleet/reg.py": """
+            import threading
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def add(self, key, value):
+                    with self._lock:
+                        self._items[key] = value
+
+                def peek(self, key):
+                    with self._lock:
+                        return self._items.get(key)
+
+                def _locked_size(self):
+                    return len(self._items)   # guarded via callers
+
+                def size(self):
+                    with self._lock:
+                        return self._locked_size()
+            """,
+        },
+        select=["JGL011"],
+    )
+    assert findings == []
+
+
+def test_jgl011_scope_is_fleet_and_observability_only(tmp_path):
+    """The same racy shape outside fleet//observability/ is not this
+    rule's business (single-threaded modules own their own state)."""
+    findings = lint_files(
+        tmp_path,
+        {
+            "inference/reg.py": """
+            import threading
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def add(self, key, value):
+                    with self._lock:
+                        self._items[key] = value
+
+                def peek(self, key):
+                    return self._items.get(key)
+            """,
+        },
+        select=["JGL011"],
+    )
+    assert findings == []
+
+
+# --------------------------------------------------------------- JGL012
+
+
+def test_jgl012_flags_bare_subscript_and_both_drift_halves(tmp_path):
+    """Across the two protocol ends: a bare-subscript read (optional-
+    field contract), a key written but never read, and a key read but
+    never written are each findings."""
+    findings = lint_files(
+        tmp_path,
+        {
+            "fleet/worker.py": """
+            def handle(header):
+                value = header["payload"]      # bare subscript
+                if header.get("kind") != "job":
+                    return None
+                return value
+
+            def reply_ok(rid):
+                reply = {"kind": "ok", "orphan_field": rid}
+                return reply
+            """,
+            "serve.py": """
+            def consume(header):
+                return header.get("ghost_field")
+            """,
+        },
+        select=["JGL012"],
+    )
+    assert findings and all(f.rule == "JGL012" for f in findings)
+    messages = [f.message for f in findings]
+    assert any(
+        "'payload'" in m and "bare" in m for m in messages
+    ), messages
+    assert any(
+        "'orphan_field'" in m and "never read" in m for m in messages
+    ), messages
+    assert any(
+        "'ghost_field'" in m and "never written" in m for m in messages
+    ), messages
+
+
+def test_jgl012_matched_keys_and_carveouts_are_clean(tmp_path):
+    """A produced-and-consumed key is clean; 'kind' (the one REQUIRED
+    field) may be subscripted; the 'trace' key inside fleet/ belongs to
+    JGL010's carve-out, not this rule."""
+    findings = lint_files(
+        tmp_path,
+        {
+            "fleet/worker.py": """
+            def reply_ok(rid, header, ctx):
+                kind = header["kind"]          # required field: honest
+                header["trace"] = ctx
+                trace = header["trace"]        # JGL010's carve-out
+                reply = {"kind": "ok", "result": rid}
+                return reply, kind, trace
+            """,
+            "serve.py": """
+            def consume(header):
+                return header.get("result"), header.get("kind")
+            """,
+        },
+        select=["JGL012"],
+    )
+    assert findings == []
+
+
+def test_jgl012_drift_needs_both_protocol_ends(tmp_path):
+    """A standalone lint of one directory cannot distinguish drift from
+    out-of-scope use: without serve.py in the linted set, the drift
+    halves stay silent (the per-site bare-subscript check still runs)."""
+    findings = lint_files(
+        tmp_path,
+        {
+            "fleet/worker.py": """
+            def reply_ok(rid):
+                return {"kind": "ok", "half_seen": rid}
+            """,
+        },
+        select=["JGL012"],
+    )
+    assert findings == []
+
+
+# --------------------------------------------------------------- JGL013
+
+
+def test_jgl013_flags_stragglers_unregistered_and_dead_knobs(tmp_path):
+    """All three halves: a direct os.environ read of a knob-prefixed
+    name (resolved through a module constant), a knob_* getter naming
+    an undeclared knob, and a registered knob nobody reads."""
+    findings = lint_files(
+        tmp_path,
+        {
+            "utils/knobs.py": """
+            KNOBS = (
+                Knob("RAFT_NCUP_ALPHA", "str", "a", "alpha knob"),
+                Knob("RAFT_NCUP_DEAD", "str", "d", "dead knob"),
+            )
+            """,
+            # The unread-knob half is gated on the full driver scope.
+            "train.py": "",
+            "serve.py": "",
+            "bench.py": """
+            import os
+            from raft_ncup_tpu.utils.knobs import knob_str
+
+            ALPHA_ENV = "RAFT_NCUP_ALPHA"
+
+            def f():
+                direct = os.environ.get(ALPHA_ENV)      # straggler
+                good = knob_str("RAFT_NCUP_ALPHA")
+                bad = knob_str("RAFT_NCUP_GHOST")       # undeclared
+                benign = os.environ.get("PATH")         # not a knob
+                return direct, good, bad, benign
+            """,
+        },
+        select=["JGL013"],
+    )
+    assert [f.rule for f in findings] == ["JGL013"] * 3
+    messages = [f.message for f in findings]
+    assert any(
+        "direct os.environ read" in m and "'RAFT_NCUP_ALPHA'" in m
+        for m in messages
+    ), messages
+    assert any("'RAFT_NCUP_GHOST'" in m for m in messages), messages
+    assert any(
+        "'RAFT_NCUP_DEAD'" in m and "ever reads it" in m for m in messages
+    ), messages
+
+
+def test_jgl013_registered_reads_and_non_knob_names_clean(tmp_path):
+    """Getter reads of registered names are the sanctioned shape;
+    non-prefixed env vars (PATH, _BENCH_* internals) are not knobs."""
+    findings = lint_files(
+        tmp_path,
+        {
+            "utils/knobs.py": """
+            KNOBS = (
+                Knob("RAFT_NCUP_ALPHA", "str", "a", "alpha knob"),
+            )
+            """,
+            "mod.py": """
+            import os
+            from raft_ncup_tpu.utils.knobs import knob_str
+
+            def f():
+                good = knob_str("RAFT_NCUP_ALPHA")
+                benign = os.environ.get("PATH")
+                internal = os.environ.get("_BENCH_FORCE_PLATFORM")
+                return good, benign, internal
+            """,
+        },
+        select=["JGL013"],
+    )
+    assert findings == []
+
+
+def test_jgl013_unread_half_needs_registry_and_drivers_in_scope(tmp_path):
+    """A package-only lint sees the registry but not the driver entry
+    points where most readers live — it cannot call a knob dead (the
+    same scope-completeness gate JGL012 applies to drift). The other
+    two halves still run per-site."""
+    findings = lint_files(
+        tmp_path,
+        {
+            "utils/knobs.py": """
+            KNOBS = (
+                Knob("RAFT_NCUP_ELSEWHERE", "str", "x",
+                     "read only by an out-of-scope driver"),
+            )
+            """,
+        },
+        select=["JGL013"],
+    )
+    assert findings == []
+
+
+def test_jgl013_runtime_registry_matches_static_declarations():
+    """The shipped registry is importable pure-stdlib, every declared
+    knob resolves through get(), and unregistered names raise — the
+    runtime half that covers dynamic getter names JGL013 cannot see."""
+    from raft_ncup_tpu.utils import knobs
+
+    assert len(knobs.KNOBS) == len({k.name for k in knobs.KNOBS})
+    for knob in knobs.KNOBS:
+        assert knobs.get(knob.name) is knob
+    with pytest.raises(KeyError):
+        knobs.get("RAFT_NCUP_NOT_A_KNOB")
+
+
+# ------------------------------------------------- astutil name resolution
+
+
+def test_collect_aliases_edge_cases():
+    import ast as _ast
+
+    from raft_ncup_tpu.analysis.astutil import collect_aliases
+
+    tree = _ast.parse(textwrap.dedent("""
+        import numpy as np
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from os import path
+        import threading
+    """))
+    aliases = collect_aliases(tree)
+    assert aliases["np"] == "numpy"
+    assert aliases["jnp"] == "jax.numpy"
+    assert aliases["P"] == "jax.sharding.PartitionSpec"
+    assert aliases["path"] == "os.path"
+    assert aliases["threading"] == "threading"
+
+
+def test_dotted_name_resolution_edge_cases():
+    import ast as _ast
+
+    from raft_ncup_tpu.analysis.astutil import (
+        collect_aliases,
+        dotted_name,
+    )
+
+    tree = _ast.parse("import numpy as np")
+    aliases = collect_aliases(tree)
+
+    def expr(src):
+        return _ast.parse(src).body[0].value
+
+    # Aliased import expands the leading segment only.
+    assert dotted_name(expr("np.random.default_rng"), aliases) == (
+        "numpy.random.default_rng"
+    )
+    # Attribute chains through self stay rooted at the literal name.
+    assert dotted_name(expr("self.tel.registry.counter"), {}) == (
+        "self.tel.registry.counter"
+    )
+    # Dynamic bases (subscripts, calls) are honestly unresolvable.
+    assert dotted_name(expr("items[0].attr"), {}) is None
+    assert dotted_name(expr("get_tel().inc"), {}) is None
+
+
+def test_qualname_nested_functions():
+    import ast as _ast
+
+    from raft_ncup_tpu.analysis.astutil import attach_parents, qualname
+
+    tree = _ast.parse(textwrap.dedent("""
+        def outer():
+            def inner():
+                return probe
+    """))
+    attach_parents(tree)
+    probe = next(
+        n for n in _ast.walk(tree)
+        if isinstance(n, _ast.Name) and n.id == "probe"
+    )
+    assert qualname(probe) == "outer.inner"
+
+
+# -------------------------------------------------------- JSON output
+
+
+def test_cli_json_output_schema(tmp_path):
+    """`--format json` is a STABLE machine surface: top-level keys,
+    per-finding keys, and the suppressed flag are pinned here so CI
+    tooling can diff lint runs across versions."""
+    import json as _json
+
+    bad = tmp_path / "fleet" / "reg.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(textwrap.dedent("""
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def add(self, key, value):
+                with self._lock:
+                    self._items[key] = value
+
+            def peek(self, key):
+                return self._items.get(key)
+    """))
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "raft_ncup_tpu.analysis",
+            str(tmp_path), "--format", "json",
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    payload = _json.loads(proc.stdout)
+    assert set(payload) == {
+        "files_checked", "findings", "parse_errors",
+        "stale_allowlist_entries", "exit_code",
+    }
+    assert payload["exit_code"] == 1 and proc.returncode == 1
+    assert payload["parse_errors"] == []
+    assert payload["files_checked"] >= 1
+    [finding] = payload["findings"]
+    assert set(finding) == {
+        "rule", "path", "line", "col", "qualname", "message", "suppressed",
+    }
+    assert finding["rule"] == "JGL011"
+    assert finding["suppressed"] is False
+    assert isinstance(finding["line"], int)
+
+
+def test_cli_json_output_clean_tree_exits_zero(tmp_path):
+    import json as _json
+
+    good = tmp_path / "mod.py"
+    good.write_text("x = 1\n")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "raft_ncup_tpu.analysis",
+            str(tmp_path), "--format", "json",
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    payload = _json.loads(proc.stdout)
+    assert proc.returncode == 0
+    assert payload["exit_code"] == 0
+    assert payload["findings"] == []
+
+
+# ------------------------------------------------------- knob catalog
+
+
+def test_perf_md_names_every_registered_knob():
+    """docs/PERF.md carries the generated knob catalog: every registered
+    knob name appears there (regenerate with
+    `python -m raft_ncup_tpu.utils.knobs`)."""
+    from raft_ncup_tpu.utils import knobs
+
+    with open(os.path.join(REPO, "docs", "PERF.md"), encoding="utf-8") as fh:
+        text = fh.read()
+    missing = [k.name for k in knobs.KNOBS if f"`{k.name}`" not in text]
+    assert not missing, (
+        f"knobs missing from docs/PERF.md (regenerate the catalog with "
+        f"`python -m raft_ncup_tpu.utils.knobs`): {missing}"
+    )
+
+
+def test_catalog_markdown_covers_registry():
+    from raft_ncup_tpu.utils import knobs
+
+    table = knobs.catalog_markdown()
+    for knob in knobs.KNOBS:
+        assert f"`{knob.name}`" in table
+
+
 # ------------------------------------------------------------ self-check
+
+
+def test_whole_program_pass_stays_fast():
+    """The project pass (one extra AST walk + three cross-module rules)
+    must not turn lint.sh into a coffee break: the full tree-wide run,
+    all rules, stays under 5 seconds."""
+    import time as _time
+
+    from raft_ncup_tpu.analysis.lint import DEFAULT_ALLOWLIST
+
+    paths = [
+        os.path.join(REPO, p)
+        for p in (
+            "raft_ncup_tpu", "train.py", "evaluate.py", "demo.py",
+            "serve.py", "bench.py", "scripts",
+        )
+    ]
+    t0 = _time.perf_counter()
+    run_lint(paths, allowlist_path=DEFAULT_ALLOWLIST)
+    assert _time.perf_counter() - t0 < 5.0
 
 
 def test_shipped_tree_lints_clean_via_module_cli():

@@ -67,7 +67,9 @@ def main(argv=None) -> int:
 
     args, model_cfg, train_cfg, data_cfg = parse_train(argv)
     initialize_distributed()  # no-op off-pod; wires processes on a pod
-    if os.environ.get("RAFT_NCUP_COMPILATION_CACHE") == "1":
+    from raft_ncup_tpu.utils.knobs import knob_flag
+
+    if knob_flag("RAFT_NCUP_COMPILATION_CACHE"):
         # Persistent XLA cache: kill/resume cycles hit warm executables
         # (resume overhead = restore latency, not a recompile). Opt-in
         # by env and OFF by default: on the CPU CI host, reloading cache
