@@ -266,6 +266,15 @@ def _child_main() -> None:
         # shared host (VERDICT r4 weak #1); the spread makes cross-round
         # deltas interpretable.
         "rep_ms": [round(t * 1e3, 1) for t in rep_times],
+        # Budgeted vs executed iterations (docs/PERF.md "Early exit").
+        # This row runs the plain full-budget scan — no convergence
+        # detection — so executed == budgeted, recorded explicitly so
+        # every row answers the same "how much refinement actually ran"
+        # question the earlyexit_* row varies.
+        "iters_budgeted": shape["iters"],
+        "iters_executed_mean": float(shape["iters"]),
+        "iters_executed_p50": shape["iters"],
+        "iters_executed_p99": shape["iters"],
     }
     if cost_entry is not None:
         # The executable's own cost facts, recorded at compile time
@@ -623,6 +632,21 @@ def _child_main() -> None:
             _emit(record)
         except Exception as e:  # never lose the earlier rows
             print(f"pipeline bench failed: {e}", file=sys.stderr)
+
+    # Early-exit row (docs/PERF.md "Early exit"; ROADMAP item 5's first
+    # half): the convergence-detection forward vs its full-budget twin
+    # over a mixed-resolution zipf stream, with the EPE-vs-speedup pair
+    # flip_recommendations judges against the pinned quality budget.
+    # Small shapes, so it fits a tail-row budget slice;
+    # BENCH_SKIP_EARLYEXIT=1 turns it off explicitly.
+    if knob_flag("BENCH_SKIP_EARLYEXIT"):
+        pass
+    elif child_budget - (time.monotonic() - t0) > 0.12 * child_budget:
+        try:
+            record.update(_measure_earlyexit(variables))
+            _emit(record)
+        except Exception as e:  # never lose the earlier rows
+            print(f"earlyexit bench failed: {e}", file=sys.stderr)
 
 
 def _measure_bf16_forward(
@@ -1249,6 +1273,7 @@ def _measure_serve(
         "serve_ok": len(lat),
         "serve_interval_ms": round(interval * 1e3, 1),
         "serve_iters": levels[0],
+        "serve_iters_budgeted": levels[0],
         "serve_shed": win_a["shed"],
         "serve_timeouts": win_a["timeouts"],
         "serve_errors": win_a["errors"],
@@ -1272,6 +1297,22 @@ def _measure_serve(
         "serve_slo_pages": slo_snap["pages_total"],
         "serve_slo": slo_snap["verdicts"],
     }
+    # Executed-iterations stats (docs/PERF.md "Early exit"): when the
+    # RAFT_NCUP_EARLYEXIT knob had convergence detection live during
+    # this window, the server's per-request serve_exec_iters histogram
+    # holds the real counts; otherwise every request ran its full
+    # budget and executed == budgeted (worst case, stated explicitly).
+    exec_hist = tel.registry.get("serve_exec_iters")
+    if exec_hist is not None and exec_hist.count:
+        record["serve_iters_executed_mean"] = round(
+            exec_hist.sum_ms / exec_hist.count, 3
+        )
+        record["serve_iters_executed_p50"] = exec_hist.percentile_ms(0.50)
+        record["serve_iters_executed_p99"] = exec_hist.percentile_ms(0.99)
+    else:
+        record["serve_iters_executed_mean"] = float(levels[0])
+        record["serve_iters_executed_p50"] = levels[0]
+        record["serve_iters_executed_p99"] = levels[0]
     # Executable cost facts from the ledger the warmup just fed
     # (inference/costs.py): the headline batch-1 top-level executable's
     # XLA flops, and MFU against the backend's peak table — non-null on
@@ -2332,6 +2373,151 @@ def _measure_pipeline(variables: dict) -> dict:
         row["pipeline_recompiles"] += ref["recompiles"]
         row["pipeline_host_transfers"] += ref["host_transfers"]
     return row
+
+
+def _measure_earlyexit(variables: dict) -> dict:
+    """Adaptive-compute row (docs/PERF.md "Early exit"): the in-graph
+    convergence-detection forward vs its own full-budget twin over a
+    mixed-resolution zipf request stream.
+
+    The stream is :class:`~raft_ncup_tpu.traffic.MixedResolutionTraffic`
+    over three small sizes (batch 1 — the serving admission shape), so
+    the recorded speedup reflects HETEROGENEOUS per-sample convergence
+    across a realistic size mix, not one shape's behavior. Both windows
+    replay the SAME frames through the SAME weights; the only variable
+    is detection, so the throughput delta is the measured FLOP cut and
+    ``earlyexit_epe_vs_full`` is the measured quality price — judged
+    against the pinned ``EARLYEXIT_EPE_BUDGET`` (precision/policy.py)
+    by flip_recommendations before any speedup may be recommended. The
+    FLOP cut is backend-honest (fewer while_loop trips is fewer FLOPs
+    everywhere), so the CPU verdict is real, unlike the pipeline row's
+    S× claim.
+
+    Guards: both windows run under the recompile watchdog and the
+    implicit-transfer tripwire — ``earlyexit_recompiles`` /
+    ``earlyexit_host_transfers`` (both windows folded) must be 0, the
+    proof that detection lives in-graph: no host pull ever inspects the
+    convergence mask, and the executable set compiled at warm time (one
+    per (shape, detection) — the tolerance is baked into the compiled
+    loop condition) is the set the window ran. Warmup compiles both
+    variants per shape outside the guards; result pulls (EPE inputs,
+    exec counts) happen after the guard scopes close.
+
+    Knobs: ``BENCH_EARLYEXIT_TOL`` (detection threshold, mean |flow
+    delta| in LOW-RES px — the default is tuned so the untrained bench
+    weights split, some lanes exiting early and some running out the
+    budget), ``BENCH_EARLYEXIT_ITERS`` (the budget both windows share),
+    ``BENCH_EARLYEXIT_REQUESTS`` (stream length),
+    ``BENCH_SKIP_EARLYEXIT`` (skip the row).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_ncup_tpu.analysis.guards import (
+        GuardStats,
+        RecompileWatchdog,
+        forbid_host_transfers,
+    )
+    from raft_ncup_tpu.config import flagship_config
+    from raft_ncup_tpu.inference.pipeline import ShapeCachedForward
+    from raft_ncup_tpu.models.raft import get_model
+    from raft_ncup_tpu.precision import EARLYEXIT_EPE_BUDGET
+    from raft_ncup_tpu.traffic import MixedResolutionTraffic
+
+    platform = jax.devices()[0].platform
+    tol = knob_float("BENCH_EARLYEXIT_TOL")
+    iters = knob_int("BENCH_EARLYEXIT_ITERS")
+    n = knob_int("BENCH_EARLYEXIT_REQUESTS")
+    strict = knob_flag("BENCH_STRICT_GUARDS")
+    sizes = [(96, 128), (64, 96), (128, 160)]
+
+    traffic = MixedResolutionTraffic(sizes, n, seed=17, style="smooth")
+    items = [
+        (
+            jnp.asarray(item.image1[None], jnp.float32),
+            jnp.asarray(item.image2[None], jnp.float32),
+        )
+        for item in traffic.schedule()
+    ]
+
+    model = get_model(flagship_config(dataset="sintel", corr_impl="onthefly"))
+    fwd = ShapeCachedForward(model, variables)
+
+    # Warm both variants for every distinct shape OUTSIDE the guards:
+    # after this, the window's executable set is closed.
+    warmed = set()
+    t0 = time.perf_counter()
+    for i1, i2 in items:
+        if i1.shape in warmed:
+            continue
+        warmed.add(i1.shape)
+        out = fwd.forward_device(i1, i2, iters, early_exit_tol=tol)
+        jax.device_get(out[1][0, 0, 0, 0])
+        out = fwd.forward_device(i1, i2, iters)
+        jax.device_get(out[1][0, 0, 0, 0])
+    warm_s = time.perf_counter() - t0
+
+    def window(ee_tol):
+        outs = []
+        stats = GuardStats()
+        with RecompileWatchdog() as wd, forbid_host_transfers(
+            stats, raise_on_violation=strict
+        ):
+            t0 = time.perf_counter()
+            for i1, i2 in items:
+                outs.append(
+                    fwd.forward_device(i1, i2, iters, early_exit_tol=ee_tol)
+                )
+            # The one sanctioned explicit device_get: the honest sync.
+            # On the single-stream backends dispatch is in-order, so the
+            # last result's scalar fences the whole window.
+            jax.device_get(outs[-1][1][0, 0, 0, 0])
+            elapsed = time.perf_counter() - t0
+        return outs, {
+            "pairs_per_sec": (
+                round(len(items) / elapsed, 4) if elapsed else 0.0
+            ),
+            "recompiles": wd.count,
+            "host_transfers": stats.host_transfers,
+        }
+
+    ee_outs, ee_w = window(tol)
+    full_outs, full_w = window(None)
+
+    # Result pulls AFTER the guard scopes: explicit, off the clock.
+    exec_iters = np.concatenate(
+        [np.asarray(jax.device_get(o[2])) for o in ee_outs]
+    ).astype(np.int64)
+    epes = []
+    for ee, full in zip(ee_outs, full_outs):
+        d = np.asarray(jax.device_get(ee[1])) - np.asarray(
+            jax.device_get(full[1])
+        )
+        epes.append(float(np.sqrt((d ** 2).sum(-1)).mean()))
+    ex = np.sort(exec_iters)
+
+    def nearest(p):  # classical nearest-rank (serving.nearest_rank_ms)
+        return int(ex[max(0, min(len(ex), int(np.ceil(p * len(ex)))) - 1)])
+    return {
+        "earlyexit_pairs_per_sec": ee_w["pairs_per_sec"],
+        "earlyexit_pairs_per_sec_fullbudget": full_w["pairs_per_sec"],
+        "earlyexit_epe_vs_full": round(float(np.mean(epes)), 4),
+        "earlyexit_epe_budget": EARLYEXIT_EPE_BUDGET,
+        "earlyexit_tol": tol,
+        "earlyexit_iters_budgeted": iters,
+        "earlyexit_iters_executed_mean": round(float(ex.mean()), 3),
+        "earlyexit_iters_executed_p50": nearest(0.50),
+        "earlyexit_iters_executed_p99": nearest(0.99),
+        "earlyexit_requests": len(items),
+        "earlyexit_size_mix": traffic.size_counts(),
+        "earlyexit_platform": platform,
+        "earlyexit_warm_s": round(warm_s, 1),
+        "earlyexit_recompiles": ee_w["recompiles"] + full_w["recompiles"],
+        "earlyexit_host_transfers": (
+            ee_w["host_transfers"] + full_w["host_transfers"]
+        ),
+    }
 
 
 def _measure_checkpoint(handles: dict) -> dict:

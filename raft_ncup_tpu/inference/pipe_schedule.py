@@ -192,23 +192,25 @@ class PipelinedForward:
 
     # ------------------------------------------------------------ programs
 
-    def _carry_struct(self, image_shape: tuple, model) -> dict:
+    def _carry_struct(
+        self, image_shape: tuple, model, early_exit: bool = False,
+    ) -> dict:
         img = jax.ShapeDtypeStruct(tuple(image_shape), IMAGE_DTYPE)
         return jax.eval_shape(
-            lambda v, a, b: model.encode(v, a, b),
+            lambda v, a, b: model.encode(v, a, b, early_exit=early_exit),
             self.variables, img, img,
         )
 
-    def _build_encode(self, model):
+    def _build_encode(self, model, early_exit: bool = False):
         repl = NamedSharding(self.mesh, P())
 
         def enc(v, i1, i2):
-            return model.encode(v, i1, i2)
+            return model.encode(v, i1, i2, early_exit=early_exit)
 
         return jax.jit(enc, in_shardings=(repl, repl, repl),
                        out_shardings=repl)
 
-    def _build_tick(self, model, seg_len: int):
+    def _build_tick(self, model, seg_len: int, early_exit_tol=None):
         mesh = self.mesh
         s = self.segments
         perm = [(i, i + 1) for i in range(s - 1)]
@@ -218,7 +220,9 @@ class PipelinedForward:
             # state, squeezed to the plain segment carry, advanced by
             # seg_len iterations of the SAME step body as apply().
             local = jax.tree.map(lambda x: x[0], block)
-            out = model.refine_segment(v, local, seg_len)
+            out = model.refine_segment(
+                v, local, seg_len, early_exit_tol=early_exit_tol
+            )
             out = jax.tree.map(lambda x: x[None], out)
             # Carry handoff: refined stage s -> stage s+1. Stage 0's
             # incoming slot is zero-filled by ppermute (no source) and
@@ -239,35 +243,59 @@ class PipelinedForward:
             )(v, state)
             done = jax.tree.map(lambda x: x[s - 1], refined)
             flow_lr, flow_up = model.finalize(v, done)
+            if early_exit_tol is not None:
+                # The finished micro-batch's per-sample executed-iters
+                # counter (quantized to segment boundaries inside
+                # refine_segment) leaves with its flow — one more tiny
+                # replicated output, no extra sync.
+                return shifted, flow_lr, flow_up, done["exec_iters"]
             return shifted, flow_lr, flow_up
 
         repl = NamedSharding(self.mesh, P())
         staged = NamedSharding(self.mesh, P("pipe"))
+        n_out = 3 if early_exit_tol is None else 4
         # Donating the state keeps the pipeline's carry buffers reused
         # in place tick over tick — steady-state memory is one stacked
         # carry, not one per in-flight tick.
         return jax.jit(
             tick,
             in_shardings=(repl, staged, repl),
-            out_shardings=(staged, repl, repl),
+            out_shardings=(staged,) + (repl,) * (n_out - 1),
             donate_argnums=(1,),
         )
 
-    def _programs(self, image_shape: tuple, iters: int, policy=None):
+    def _programs(
+        self, image_shape: tuple, iters: int, policy=None,
+        early_exit_tol=None,
+    ):
         """(encode, tick, model, pol) — compiled-on-first-call via the
-        cache, keyed by (shape, iters, segments, policy)."""
+        cache, keyed by (shape, iters, segments, policy). Early-exit
+        programs append a ``("earlyexit", tol)`` key element (exactly
+        like ``forward_device``): detection-off deployments keep their
+        existing keys and executables untouched."""
         model, pol = self.cache.model_for(policy)
         seg_len = split_iters(iters, self.segments)
         shape = tuple(image_shape)
         fp = pol.fingerprint()
+        ee_key = ()
+        if early_exit_tol is not None:
+            early_exit_tol = float(early_exit_tol)
+            ee_key = (("earlyexit", early_exit_tol),)
         enc = self.cache.custom(
-            ("pipe_encode", shape, fp), lambda: self._build_encode(model)
+            ("pipe_encode", shape, fp) + ee_key,
+            lambda: self._build_encode(
+                model, early_exit=early_exit_tol is not None
+            ),
         )
         tick = self.cache.custom(
-            ("pipe_tick", shape, int(iters), self.segments, fp),
-            lambda: self._build_tick(model, seg_len),
+            ("pipe_tick", shape, int(iters), self.segments, fp) + ee_key,
+            lambda: self._build_tick(
+                model, seg_len, early_exit_tol=early_exit_tol
+            ),
         )
-        self._tick_handles[(shape, int(iters), self.segments, fp)] = tick
+        self._tick_handles[
+            (shape, int(iters), self.segments, fp) + ee_key
+        ] = tick
         return enc, tick, model, pol
 
     def _zero_state(self, carry_sds: dict):
@@ -293,6 +321,7 @@ class PipelinedForward:
 
     def forward_many(
         self, pairs: Sequence[tuple], iters: int, policy=None,
+        early_exit_tol: Optional[float] = None,
     ) -> list:
         """Stream ``pairs`` (same-shape ``(image1, image2)`` micro-
         batches) through the pipeline; returns the per-micro-batch
@@ -303,10 +332,21 @@ class PipelinedForward:
         (S-1 flush ticks at the tail). The steady state is guard-clean:
         every tick after the first reuses the same two executables and
         performs no host transfer.
+
+        ``early_exit_tol`` (docs/PERF.md "Early exit"): each result
+        becomes the 3-tuple ``(flow_lr, flow_up, exec_iters)``. Under
+        the pipe axis exits QUANTIZE to segment boundaries — the tick
+        schedule is fixed, so a converged lane rides frozen (bitwise,
+        per-iteration ``jnp.where`` inside ``refine_segment``) to the
+        next seam and ``exec_iters`` bills whole segments:
+        ``exec_pipe == ceil(exec_mono / seg_len) * seg_len``.
         """
         if self.segments == 1:
             return [
-                self.cache.forward_device(i1, i2, iters, policy=policy)
+                self.cache.forward_device(
+                    i1, i2, iters, policy=policy,
+                    early_exit_tol=early_exit_tol,
+                )
                 for i1, i2 in pairs
             ]
         split_iters(iters, self.segments)  # validate before compiling
@@ -314,8 +354,12 @@ class PipelinedForward:
         if not pairs:
             return []
         shape = tuple(jnp.shape(pairs[0][0]))
-        enc, tick, model, _pol = self._programs(shape, iters, policy)
-        carry_sds = self._carry_struct(shape, model)
+        enc, tick, model, _pol = self._programs(
+            shape, iters, policy, early_exit_tol=early_exit_tol
+        )
+        carry_sds = self._carry_struct(
+            shape, model, early_exit=early_exit_tol is not None
+        )
         state = self._zero_state(carry_sds)
         flush = self._zero_fresh(carry_sds)
         s = self.segments
@@ -328,9 +372,18 @@ class PipelinedForward:
                 )
             else:
                 fresh = flush
-            state, flow_lr, flow_up = tick(self.variables, state, fresh)
-            if t >= s - 1:
-                outs.append((flow_lr, flow_up))
+            if early_exit_tol is not None:
+                state, flow_lr, flow_up, exec_iters = tick(
+                    self.variables, state, fresh
+                )
+                if t >= s - 1:
+                    outs.append((flow_lr, flow_up, exec_iters))
+            else:
+                state, flow_lr, flow_up = tick(
+                    self.variables, state, fresh
+                )
+                if t >= s - 1:
+                    outs.append((flow_lr, flow_up))
         return outs
 
     # ---------------------------------------------------------- inspection

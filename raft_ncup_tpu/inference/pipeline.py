@@ -62,6 +62,20 @@ from raft_ncup_tpu.precision import resolve_policy
 _EXEC_CANON = LEGACY_KEY_ALIASES["inference"]
 
 
+def env_earlyexit_tol() -> Optional[float]:
+    """Resolve the early-exit knobs (utils/knobs.py; docs/PERF.md "Early
+    exit") to a tolerance, or None when detection is off. This is THE
+    env chokepoint for early exit: the model layer takes an explicit
+    ``early_exit_tol`` argument and never reads the environment, so
+    compiled-program identity stays a pure function of call arguments.
+    """
+    from raft_ncup_tpu.utils.knobs import knob_flag, knob_float
+
+    if not knob_flag("RAFT_NCUP_EARLYEXIT"):
+        return None
+    return knob_float("RAFT_NCUP_EARLYEXIT_TOL")
+
+
 class SamplePrefetcher:
     """Decode dataset samples ahead of consumption, order-preserving.
 
@@ -453,9 +467,22 @@ class ShapeCachedForward:
         the raw (pre-mesh-fingerprint) executable key so consumers
         filter on (kind, shape, iters) instead of string-matching keys."""
         if key and isinstance(key[0], tuple):
-            # forward key: (shape, iters, warm, policy_fp)
-            return {"kind": "forward", "shape": key[0], "iters": key[1],
+            # forward key: (shape, iters, warm, policy_fp) — plus an
+            # optional trailing ("earlyexit", tol) marker for the
+            # convergence-detection twin of a shape (docs/PERF.md "Early
+            # exit"): the threshold knob rides the ledger meta exactly
+            # like the corr band knobs, so flip_recommendations can
+            # attribute an EPE-vs-speedup trade to the tolerance that
+            # produced it.
+            meta = {"kind": "forward", "shape": key[0], "iters": key[1],
                     "policy": key[3]}
+            for part in key[4:]:
+                if (
+                    isinstance(part, tuple) and len(part) == 2
+                    and part[0] == "earlyexit"
+                ):
+                    meta["earlyexit_tol"] = part[1]
+            return meta
         if key and key[0] == "metrics":
             # ("metrics", img_shape, flow_shape, extras, iters, kind,
             #  pad, warm, policy_fp) — policy distinguishes the f32 and
@@ -470,13 +497,24 @@ class ShapeCachedForward:
             # the ledger meta so costs.record_compiled can derive
             # per-segment flops/bytes and flip_recommendations can
             # judge the pipeline against the monolithic scan.
+            meta = None
             if len(key) >= 6 and key[1] == "pipe_tick":
-                return {"kind": "pipe_tick", "shape": key[2],
+                meta = {"kind": "pipe_tick", "shape": key[2],
                         "iters": key[3], "segments": key[4],
                         "policy": key[5]}
-            if len(key) >= 4 and key[1] == "pipe_encode":
-                return {"kind": "pipe_encode", "shape": key[2],
+            elif len(key) >= 4 and key[1] == "pipe_encode":
+                meta = {"kind": "pipe_encode", "shape": key[2],
                         "policy": key[3]}
+            if meta is not None:
+                # Optional trailing ("earlyexit", tol) marker — same
+                # contract as the forward key above.
+                for part in key[4:]:
+                    if (
+                        isinstance(part, tuple) and len(part) == 2
+                        and part[0] == "earlyexit"
+                    ):
+                        meta["earlyexit_tol"] = part[1]
+                return meta
             return {"kind": "custom"}
         return {}
 
@@ -581,6 +619,7 @@ class ShapeCachedForward:
 
     def forward_device(
         self, image1, image2, iters: int, flow_init=None, policy=None,
+        early_exit_tol: Optional[float] = None,
     ):
         """Test-mode forward; returns DEVICE arrays (flow_lr, flow_up).
 
@@ -589,20 +628,40 @@ class ShapeCachedForward:
         explicit ``jax.device_get``. ``policy`` overrides the instance
         precision policy for this call; the fingerprint in the key keeps
         the override's executable distinct.
+
+        ``early_exit_tol`` (docs/PERF.md "Early exit"): compile the
+        convergence-detection variant — the return becomes the 3-tuple
+        ``(flow_lr, flow_up, exec_iters)`` with ``exec_iters`` the (B,)
+        int32 per-sample executed-iteration count, still device-resident
+        (it rides the caller's existing drain/pull; never a second
+        sync). The key grows a trailing ``("earlyexit", tol)`` element,
+        so detection-off callers keep their exact 4-tuple keys and
+        executables — zero churn for existing deployments — while each
+        tolerance is its own executable (the tolerance is baked into the
+        compiled loop condition).
         """
         model, pol = self.model_for(policy)
         key = (
             tuple(image1.shape), iters, flow_init is not None,
             pol.fingerprint(),
         )
+        if early_exit_tol is not None:
+            key = key + (("earlyexit", float(early_exit_tol)),)
 
         def build():
             mesh = self.mesh
+            tol = (
+                None if early_exit_tol is None else float(early_exit_tol)
+            )
+            kw = {}
+            if tol is not None:
+                kw = {"early_exit_tol": tol, "return_exec_iters": True}
             if flow_init is None:
 
                 def fn(v, i1, i2):
                     return model.apply(
-                        v, i1, i2, iters=iters, test_mode=True, mesh=mesh
+                        v, i1, i2, iters=iters, test_mode=True, mesh=mesh,
+                        **kw,
                     )
 
             else:
@@ -610,11 +669,12 @@ class ShapeCachedForward:
                 def fn(v, i1, i2, finit):
                     return model.apply(
                         v, i1, i2, iters=iters, flow_init=finit,
-                        test_mode=True, mesh=mesh,
+                        test_mode=True, mesh=mesh, **kw,
                     )
 
             return self._jit(
-                fn, 2 if flow_init is None else 3, 0, n_out=2
+                fn, 2 if flow_init is None else 3, 0,
+                n_out=2 if early_exit_tol is None else 3,
             )
 
         args = (jnp.asarray(image1), jnp.asarray(image2))

@@ -372,12 +372,27 @@ class RAFT:
 
     def _make_step(
         self, run, corr_fn, coords0, inp, bstats, *, test_mode,
-        carry_mask, bn_train,
+        carry_mask, bn_train, early_exit_tol=None,
     ):
         """One refinement iteration on the ``(net, coords1, stats)``
         carry — the single step body every scan (monolithic or segment)
-        runs, so segmented execution can never drift from ``apply``."""
+        runs, so segmented execution can never drift from ``apply``.
+
+        ``early_exit_tol`` (test mode only; docs/PERF.md "Early exit"):
+        per-sample convergence detection on the GRU's own flow delta.
+        The carry's ``stats['converged']`` (B,) bool marks lanes whose
+        mean |delta| fell below the tolerance on an EARLIER iteration;
+        those lanes' ``(net, coords1, up_mask)`` are frozen via
+        ``jnp.where`` — a select, so a lane converged at iteration k is
+        BITWISE the state it had after k (the same select contract as
+        the streaming warm start). The mask is sticky and the freeze
+        reads the mask from step ENTRY, so the iteration that detects
+        convergence still commits its own update. Everything stays on
+        device: no shape change, no host pull, no recompile.
+        """
         policy = self.policy
+        if early_exit_tol is not None and not test_mode:
+            raise ValueError("early_exit_tol requires test_mode=True")
 
         def step(carry, _):
             net, coords1, stats = carry
@@ -385,6 +400,7 @@ class RAFT:
             # per-iteration BatchNorm state (upsampler only).
             if "upsampler" in stats:
                 bstats["upsampler"] = stats["upsampler"]
+            net_in, coords1_in = net, coords1
             coords1 = jax.lax.stop_gradient(coords1)  # .detach() per iter
             # Stage labels inside the scanned refinement iteration: the
             # lookup and the GRU update are the two halves an xprof
@@ -407,6 +423,23 @@ class RAFT:
             # the carried state (the error-budget argument).
             coords1 = coords1 + delta.astype(policy.coord_jnp)
 
+            converged = None
+            if early_exit_tol is not None:
+                frozen = stats["converged"]  # mask at step ENTRY
+                keep = frozen[:, None, None, None]
+                net = jnp.where(keep, net_in, net)
+                coords1 = jnp.where(keep, coords1_in, coords1)
+                if carry_mask:
+                    up_mask = jnp.where(keep, stats["up_mask"], up_mask)
+                # Detection norm: mean |delta| per sample, in the pinned
+                # coord dtype and in LOW-RES pixels (the 8x upsampling
+                # scales displacements, so tol=t low-res px bounds the
+                # remaining full-res drift by ~8t px per skipped iter).
+                dnorm = jnp.mean(
+                    jnp.abs(delta.astype(policy.coord_jnp)), axis=(1, 2, 3)
+                )
+                converged = frozen | (dnorm < early_exit_tol)
+
             if test_mode:
                 out = None
             else:
@@ -418,6 +451,16 @@ class RAFT:
                 new_stats["upsampler"] = bstats["upsampler"]
             if carry_mask:
                 new_stats["up_mask"] = up_mask
+            if converged is not None:
+                new_stats["converged"] = converged
+                if "exec_iters" in stats:
+                    # Per-lane executed-iteration count: a lane active at
+                    # step entry pays this iteration; a frozen lane does
+                    # not. (Segment-granularity counting — the pipelined
+                    # path — happens in refine_segment instead.)
+                    new_stats["exec_iters"] = stats["exec_iters"] + (
+                        ~frozen
+                    ).astype(jnp.int32)
             return (net, coords1, new_stats), out
 
         return step
@@ -450,6 +493,8 @@ class RAFT:
         net_init: Optional[jax.Array] = None,
         net_warm: Optional[jax.Array] = None,
         return_net: bool = False,
+        early_exit_tol: Optional[float] = None,
+        return_exec_iters: bool = False,
     ):
         """Estimate optical flow between a pair of NHWC image batches.
 
@@ -485,7 +530,28 @@ class RAFT:
         partitions pathologically (6x the single-device temp memory,
         measured in tests/test_highres.py); the explicit map makes spatial
         sharding actually reduce per-device memory.
+
+        ``early_exit_tol``/``return_exec_iters`` (test mode only;
+        docs/PERF.md "Early exit"): with a tolerance set, the refinement
+        runs as a ``lax.while_loop`` whose condition is ``t < iters AND
+        any lane still active`` — per-sample convergence freezes a
+        lane's carry bitwise (see ``_make_step``), and the batch-level
+        condition genuinely stops the loop once every lane converged,
+        which is what makes the FLOP cut real rather than
+        compute-and-discard. The condition never leaves the device and
+        the carry shapes are identical to the scan's, so the cache key,
+        sharding and donation story are unchanged.
+        ``return_exec_iters=True`` appends the per-sample (B,) int32
+        executed-iteration count as the LAST result element.
         """
+        if early_exit_tol is not None and not test_mode:
+            raise ValueError("early_exit_tol requires test_mode=True")
+        if return_exec_iters and early_exit_tol is None:
+            raise ValueError(
+                "return_exec_iters requires early_exit_tol (without "
+                "detection every lane runs the full budget by definition)"
+            )
+
         policy = self.policy
         params = variables["params"]
         bstats = dict(variables.get("batch_stats", {}))
@@ -505,6 +571,7 @@ class RAFT:
         step = self._make_step(
             run, corr_fn, coords0, inp, bstats,
             test_mode=test_mode, carry_mask=carry_mask, bn_train=bn_train,
+            early_exit_tol=early_exit_tol,
         )
 
         init_stats: dict = {}
@@ -514,15 +581,40 @@ class RAFT:
             init_stats["up_mask"] = jnp.zeros(
                 (B, H // 8, W // 8, 9 * 64), net.dtype
             )
+        if early_exit_tol is not None:
+            init_stats["converged"] = jnp.zeros((B,), jnp.bool_)
+            init_stats["exec_iters"] = jnp.zeros((B,), jnp.int32)
 
         body = step
         if train and remat:
             body = jax.checkpoint(step)
 
         with jax.named_scope("raft.refinement"):
-            (net, coords1, final_stats), flow_seq = jax.lax.scan(
-                body, (net, coords1, init_stats), None, length=iters
-            )
+            if early_exit_tol is not None:
+                # while_loop, not scan: the loop condition — all on
+                # device — exits the moment every lane converged, so
+                # trailing iterations cost nothing at all (test mode has
+                # no per-iteration outputs, so no stacked outs to keep).
+                def _cond(state):
+                    t, (_n, _c, stats) = state
+                    return jnp.logical_and(
+                        t < iters, jnp.any(~stats["converged"])
+                    )
+
+                def _body(state):
+                    t, carry = state
+                    carry, _ = body(carry, None)
+                    return t + jnp.int32(1), carry
+
+                _, (net, coords1, final_stats) = jax.lax.while_loop(
+                    _cond, _body,
+                    (jnp.int32(0), (net, coords1, init_stats)),
+                )
+                flow_seq = None
+            else:
+                (net, coords1, final_stats), flow_seq = jax.lax.scan(
+                    body, (net, coords1, init_stats), None, length=iters
+                )
         if "upsampler" in final_stats:
             bstats["upsampler"] = final_stats["upsampler"]
 
@@ -539,6 +631,8 @@ class RAFT:
                 result = (coords1 - coords0, flow_up, net)
             else:
                 result = (coords1 - coords0, flow_up)
+            if return_exec_iters:
+                result = result + (final_stats["exec_iters"],)
         else:
             if metric_head is not None:
                 raise ValueError("metric_head requires test_mode=True")
@@ -561,6 +655,7 @@ class RAFT:
         net_init: Optional[jax.Array] = None,
         net_warm: Optional[jax.Array] = None,
         rngs: Optional[dict] = None,
+        early_exit: bool = False,
     ) -> dict:
         """Pipeline front half (inference): everything before the first
         refinement iteration, returned as a SEGMENT CARRY dict —
@@ -572,6 +667,12 @@ class RAFT:
           context, which must TRAVEL WITH the state between pipeline
           stages (stage s+1 refining this micro-batch needs its feature
           maps, not its neighbor's).
+
+        ``early_exit=True`` seeds the convergence-detection keys the
+        early-exit segments read and update: ``converged`` (B,) bool
+        (all False — every lane starts active) and ``exec_iters`` (B,)
+        int32 (zeros). They ride the carry between stages like the rest
+        of the state; ``finalize`` ignores them.
 
         ``encode -> refine_segment x S -> finalize`` reproduces
         ``apply(test_mode=True)`` exactly: same submodule code, same
@@ -589,9 +690,13 @@ class RAFT:
             "net": net, "coords1": coords1, "inp": inp,
             "fmap1": fmap1, "fmap2": fmap2,
         }
+        B = net.shape[0]
         if self._has_mask:
-            B, h8, w8 = net.shape[:3]
+            _, h8, w8 = net.shape[:3]
             carry["up_mask"] = jnp.zeros((B, h8, w8, 9 * 64), net.dtype)
+        if early_exit:
+            carry["converged"] = jnp.zeros((B,), jnp.bool_)
+            carry["exec_iters"] = jnp.zeros((B,), jnp.int32)
         return carry
 
     def refine_segment(
@@ -602,6 +707,7 @@ class RAFT:
         mesh=None,
         spatial_axis: str = "spatial",
         rngs: Optional[dict] = None,
+        early_exit_tol: Optional[float] = None,
     ) -> dict:
         """Advance a segment carry by ``iters`` contiguous refinement
         iterations (one ``lax.scan`` — one compiled iteration body, as
@@ -611,7 +717,20 @@ class RAFT:
         boundary) refines identically to one that never moved; for the
         'volume' impl this re-derives the pyramid per segment — one
         matmul + avg-pools, cheap against a segment of GRU iterations,
-        and bitwise the same pyramid every time."""
+        and bitwise the same pyramid every time.
+
+        ``early_exit_tol`` (carry must be seeded with
+        ``encode(..., early_exit=True)``): per-iteration convergence
+        detection and freeze run INSIDE the segment — flow is identical
+        to the monolithic early-exit path — but the executed-iters
+        count quantizes to SEGMENT boundaries: a lane active at segment
+        entry is billed the whole segment, because under the pipe axis
+        the tick executable runs on schedule regardless and a segment
+        seam is the first point a lane's exit is observable. So
+        ``exec_iters(pipelined) == ceil(exec_iters(monolithic) /
+        seg_len) * seg_len`` — the quantization contract
+        tests/test_earlyexit.py pins for S in {1, 2, 4}.
+        """
         run = self._make_run(
             variables["params"], dict(variables.get("batch_stats", {})),
             False, rngs,
@@ -623,9 +742,17 @@ class RAFT:
         coords0 = coords_grid(B, h8, w8)
         carry_mask = "up_mask" in carry
         stats = {"up_mask": carry["up_mask"]} if carry_mask else {}
+        if early_exit_tol is not None:
+            if "converged" not in carry:
+                raise ValueError(
+                    "early_exit_tol requires a carry seeded with "
+                    "encode(..., early_exit=True)"
+                )
+            stats["converged"] = carry["converged"]
         step = self._make_step(
             run, corr_fn, coords0, carry["inp"], {},
             test_mode=True, carry_mask=carry_mask, bn_train=False,
+            early_exit_tol=early_exit_tol,
         )
         with jax.named_scope("raft.refinement"):
             (net, coords1, out_stats), _ = jax.lax.scan(
@@ -637,6 +764,14 @@ class RAFT:
         out["coords1"] = coords1
         if carry_mask:
             out["up_mask"] = out_stats["up_mask"]
+        if early_exit_tol is not None:
+            out["converged"] = out_stats["converged"]
+            # Segment-granularity billing (see docstring): lanes active
+            # at segment ENTRY pay the full segment.
+            entry_active = ~carry["converged"]
+            out["exec_iters"] = carry["exec_iters"] + iters * (
+                entry_active.astype(jnp.int32)
+            )
         return out
 
     def finalize(

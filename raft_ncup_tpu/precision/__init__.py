@@ -11,6 +11,7 @@ graftlint JGL009 (no raw dtype literals in hot-path modules).
 from raft_ncup_tpu.precision.policy import (
     BF16_INFER,
     BF16_TRAIN,
+    EARLYEXIT_EPE_BUDGET,
     F32,
     FORWARD_EPE_BUDGET,
     PRESET_NAMES,
@@ -23,6 +24,7 @@ from raft_ncup_tpu.precision.policy import (
 __all__ = [
     "BF16_INFER",
     "BF16_TRAIN",
+    "EARLYEXIT_EPE_BUDGET",
     "F32",
     "FORWARD_EPE_BUDGET",
     "PRESETS",

@@ -75,6 +75,17 @@ _ALLOWED = ("float32", "bfloat16")
 FORWARD_EPE_BUDGET = 0.5  # px: test-mode forward / serving / streaming
 TRAIN_LOSS_RTOL = 0.15  # relative per-step loss-trajectory tolerance
 
+# Early exit rides the same error-budget discipline (docs/PERF.md
+# "Early exit"): the adaptive-compute path is HELD to this mean-EPE
+# delta vs its own full-budget twin (same inputs, same weights, no
+# detection) before a speedup may be recommended. The detection norm
+# bounds remaining full-res drift by ~8*tol px per skipped iteration
+# (the 8x upsample scales displacements), so a tolerance in the
+# recommended range keeps the delta far inside this budget; the pinned
+# value sits above detection-boundary noise, not above real quality
+# loss (tests/test_earlyexit.py measures the actual deltas under it).
+EARLYEXIT_EPE_BUDGET = 0.5  # px: early-exit vs full-budget twin
+
 
 @dataclass(frozen=True)
 class PrecisionPolicy:

@@ -32,7 +32,7 @@ drop/recover trajectories).
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 
 class IterationBudgetController:
@@ -84,6 +84,11 @@ class IterationBudgetController:
         self.recoveries = 0
         self.slo_drops = 0  # drops where the SLO verdict was the cause
         self.decisions: List[int] = [0] * len(levels)  # per-level counts
+        # Executed-iterations EWMA (early exit, docs/PERF.md): None until
+        # the first observation — an unfed controller is BITWISE the
+        # worst-case controller (expected_scale() == 1.0).
+        self._exec_ewma: Optional[float] = None
+        self.exec_alpha = 0.25
 
     @property
     def level(self) -> int:
@@ -93,6 +98,41 @@ class IterationBudgetController:
     def iters(self) -> int:
         """Current budget without making a decision (reporting only)."""
         return self.levels[self._level]
+
+    # ------------------------------------------- expected-iteration model
+
+    def note_executed(self, executed_iters: float) -> None:
+        """Feed one batch's mean EXECUTED iteration count (early exit,
+        docs/PERF.md "Early exit"): the EWMA turns the per-batch counts
+        the dispatch path already observes into the controller's model
+        of what a request actually costs. Clamped into
+        ``(1, levels[0])`` — a bogus observation (zero, negative, or
+        above the top budget) must not corrupt the occupancy scale."""
+        x = min(float(self.levels[0]), max(1.0, float(executed_iters)))
+        if self._exec_ewma is None:
+            self._exec_ewma = x
+        else:
+            a = self.exec_alpha
+            self._exec_ewma = a * x + (1.0 - a) * self._exec_ewma
+
+    @property
+    def expected_iters(self) -> float:
+        """The controller's per-request cost model: the executed-iters
+        EWMA when early exit has been feeding it, else the worst case
+        (the top level — exactly the pre-early-exit assumption)."""
+        if self._exec_ewma is None:
+            return float(self.levels[0])
+        return self._exec_ewma
+
+    def expected_scale(self) -> float:
+        """Fraction of the worst-case budget a request is EXPECTED to
+        cost (1.0 when never fed — the unfed controller is bitwise the
+        PR-12 controller). Scales occupancy in :meth:`decide`: a queue
+        of requests that exit after half their budget is only half the
+        work the same depth represented under worst-case accounting, so
+        the controller admits more depth at the same watermarks — more
+        admitted load at the same p99."""
+        return min(1.0, self.expected_iters / float(self.levels[0]))
 
     def decide(self, queue_depth: int, slo_degraded: bool = False) -> int:
         """One decision: observe ``queue_depth`` (and the SLO verdict),
@@ -110,7 +150,17 @@ class IterationBudgetController:
         AND occupancy must sit at/below low_water for the patience
         window.
         """
-        occ = min(1.0, max(0, int(queue_depth)) / self.capacity)
+        # Occupancy is EXPECTED-WORK occupancy: raw depth scaled by the
+        # executed-iters model (expected_scale() == 1.0 until early exit
+        # feeds note_executed — worst-case accounting, the exact PR-12
+        # behavior). The SLO verdict is deliberately NOT scaled: a
+        # burning objective degrades immediately regardless of how cheap
+        # the model thinks a request is.
+        occ = min(
+            1.0,
+            (max(0, int(queue_depth)) / self.capacity)
+            * self.expected_scale(),
+        )
         if occ >= self.high_water or slo_degraded:
             self._calm = 0
             if self._level < len(self.levels) - 1:
@@ -140,5 +190,6 @@ class IterationBudgetController:
         )
         return (
             f"budget: level={self._level} ({self.iters} iters) "
+            f"expected={self.expected_iters:.1f} "
             f"drops={self.drops} recoveries={self.recoveries} [{per}]"
         )

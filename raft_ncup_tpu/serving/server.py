@@ -63,6 +63,7 @@ from raft_ncup_tpu.inference.pipeline import (
     AsyncDrain,
     DispatchThrottle,
     ShapeCachedForward,
+    env_earlyexit_tol,
 )
 from raft_ncup_tpu.observability import get_telemetry
 from raft_ncup_tpu.ops.padding import InputPadder
@@ -152,6 +153,11 @@ class FlowServer:
                 int(mesh.shape.get("pipe", 1)) if mesh is not None else 1
             ),
         )
+        # Early exit (docs/PERF.md "Early exit"): resolved from the env
+        # knobs ONCE at construction — executable identity must not flip
+        # mid-run with the environment. None = detection off, the exact
+        # pre-early-exit serving path and executables.
+        self._earlyexit_tol = env_earlyexit_tol()
         self._throttle = DispatchThrottle(self.cfg.inflight)
         self._drainer = AsyncDrain(depth=self.cfg.drain_depth)
         self._handles: dict[int, ServeHandle] = {}
@@ -405,6 +411,7 @@ class FlowServer:
         from raft_ncup_tpu.utils.profiling import stage_annotation
 
         trace_ids = [r.trace_id for r in live if r.trace_id is not None]
+        ee_tol = self._earlyexit_tol
         with self._tel.span(
             "serve_dispatch",
             batch_id=token,
@@ -413,28 +420,63 @@ class FlowServer:
             mesh=self._fwd.mesh_fp,
             policy=self._fwd.policy.name,
             **({"trace_ids": trace_ids} if trace_ids else {}),
+            **({"earlyexit_tol": ee_tol} if ee_tol is not None else {}),
         ), stage_annotation("serve.dispatch"):
-            _, flow_up = self._fwd.forward_device(img1, img2, iters)
+            if ee_tol is not None:
+                # Detection on: the executed-iters counter rides the
+                # SAME drain tree as the flow — the per-batch summary
+                # reaches the host through the one sanctioned pull, no
+                # second sync, no extra executable output path.
+                _, flow_up, exec_iters = self._fwd.forward_device(
+                    img1, img2, iters, early_exit_tol=ee_tol
+                )
+                drain_tree = (flow_up, exec_iters)
+            else:
+                _, flow_up = self._fwd.forward_device(img1, img2, iters)
+                drain_tree = flow_up
             self._throttle.push(flow_up)
         with self._inflight_lock:
             self._inflight[token] = live
 
-        def deliver(host_flow, live=live, iters=iters, token=token):
+        def deliver(host_out, live=live, iters=iters, token=token):
             with self._inflight_lock:
                 self._inflight.pop(token, None)
             done = self._clock()
+            if ee_tol is not None:
+                host_flow, host_exec = host_out
+            else:
+                host_flow, host_exec = host_out, None
             # Dispatch -> delivered: device compute + the sanctioned
             # drain-worker pull, one per batch. The pull counter is the
             # independent measurement flip_recommendations checks
             # against stats.batches for snapshot consistency.
             self._tel.inc("serve_drain_pulls_total")
             tids = [r.trace_id for r in live if r.trace_id is not None]
+            exec_attrs = {}
+            if host_exec is not None:
+                # Executed-iters summary over the LIVE rows only — the
+                # zero batch-pad rows converge instantly and would bias
+                # the mean the controller budgets from.
+                live_exec = np.asarray(host_exec)[: len(live)]
+                exec_attrs = {
+                    "iters_budgeted": iters,
+                    "iters_executed_mean": round(
+                        float(live_exec.mean()), 3
+                    ),
+                }
             self._tel.observe_ms(
                 "serve_drain", (done - t_dispatch) * 1e3,
                 batch_id=token,
                 request_ids=[r.request_id for r in live],
                 **({"trace_ids": tids} if tids else {}),
+                **exec_attrs,
             )
+            if host_exec is not None:
+                for k in range(len(live)):
+                    self._tel.hist_observe(
+                        "serve_exec_iters", float(live_exec[k])
+                    )
+                self.budget.note_executed(float(live_exec.mean()))
             for k, req in enumerate(live):
                 (t, b), (le, r) = req.pad_spec
                 hh, ww = host_flow.shape[1], host_flow.shape[2]
@@ -456,7 +498,7 @@ class FlowServer:
             # backlog exactly when sheds happen.
             self._note_service((done - t_dispatch) / len(live))
 
-        self._drainer.submit(flow_up, deliver)
+        self._drainer.submit(drain_tree, deliver)
 
     def _fail_inflight(self, exc: BaseException) -> None:
         """Complete every batch stranded by a drain-worker failure with
@@ -531,7 +573,13 @@ class FlowServer:
         for n in self.cfg.batch_sizes:
             zeros = np.zeros((n, ph, pw, 3), np.float32)
             for iters in self.cfg.iter_levels:
-                out = self._fwd.forward_device(zeros, zeros, iters)
+                # Warm the exact program the dispatch path will run —
+                # with detection on, that is the early-exit executable
+                # (no request must ever pay its compile).
+                out = self._fwd.forward_device(
+                    zeros, zeros, iters,
+                    early_exit_tol=self._earlyexit_tol,
+                )
                 jax.block_until_ready(out)
                 warmed.append((ph, pw, n, iters))
         self.warmed = warmed
@@ -604,6 +652,9 @@ class FlowServer:
             "budget_drops": self.budget.drops,
             "budget_recoveries": self.budget.recoveries,
             "budget_slo_drops": self.budget.slo_drops,
+            "budget_expected_iters": round(
+                self.budget.expected_iters, 3
+            ),
             "executables": dict(self._fwd.stats),
             "precision": self._fwd.policy.name,  # RESOLVED (None inherits)
             "mesh": self._fwd.mesh_fp,

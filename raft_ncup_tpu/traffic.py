@@ -44,7 +44,10 @@ import numpy as np
 from raft_ncup_tpu.data.synthetic import SyntheticFlowDataset
 from raft_ncup_tpu.resilience.chaos import ChaosSpec
 
-__all__ = ["TrafficPhase", "StepTraffic", "TrafficItem"]
+__all__ = [
+    "TrafficPhase", "StepTraffic", "TrafficItem",
+    "MixedResolutionTraffic",
+]
 
 
 @dataclass(frozen=True)
@@ -175,6 +178,146 @@ class StepTraffic:
                         image1=img1, image2=img2,
                     )
                 index += 1
+
+    def __iter__(self) -> Iterator[Tuple[float, np.ndarray, np.ndarray]]:
+        """``serving/traffic.replay`` compatibility: bare
+        ``(due_s, image1, image2)`` triples."""
+        for item in self.schedule():
+            yield item.due_s, item.image1, item.image2
+
+    def items(self) -> Iterator[dict]:
+        """``fleet/router.replay_fleet`` compatibility: one dict per
+        arrival (extra keys ride along for the bench's attribution)."""
+        for item in self.schedule():
+            yield {
+                "image1": item.image1,
+                "image2": item.image2,
+                "due_s": item.due_s,
+                "phase": item.phase,
+                "index": item.index,
+            }
+
+
+class MixedResolutionTraffic:
+    """Deterministic mixed-RESOLUTION arrival schedule with a zipf
+    popularity law over frame sizes (second slice of ROADMAP item 4's
+    scenario suite; the first was the rate-step schedule above).
+
+    Production flow traffic is not one synthetic shape: a few sizes
+    dominate (the product's default capture resolutions) with a long
+    tail of odd ones — the classic zipf shape. This scenario draws each
+    request's size from ``P(rank r) ∝ (r+1)^-exponent`` over ``sizes``
+    (listed most-popular first), with one ``SyntheticFlowDataset`` per
+    size so frame content stays a pure function of ``(seed, sizes)`` —
+    the same schedule replays bitwise-identically into any consumer.
+    The early-exit bench row (docs/PERF.md "Early exit") drives its
+    measurement with this scenario, so the recorded speedup reflects
+    HETEROGENEOUS per-sample convergence across a realistic size mix
+    rather than one shape's behavior.
+
+    Attribution reuses :class:`TrafficItem` with the size name (e.g.
+    ``"96x128"``) as the phase, so per-size latency/exec-iters breakouts
+    fall out of the same phase bucketing the step schedule uses. Chaos
+    composes identically: coordinates are global request indices
+    (``burst@N`` multiplies request N at its size; ``poison@N`` NaNs its
+    first frame).
+    """
+
+    def __init__(
+        self,
+        sizes,
+        n_requests: int,
+        *,
+        exponent: float = 1.1,
+        interval_s: float = 0.0,
+        seed: int = 0,
+        burst_size: int = 8,
+        chaos: Optional[ChaosSpec] = None,
+        style: str = "smooth",
+    ):
+        self.sizes = [tuple(int(x) for x in s) for s in sizes]
+        if not self.sizes:
+            raise ValueError("a mixed-resolution schedule needs sizes")
+        if len(set(self.sizes)) != len(self.sizes):
+            raise ValueError(f"sizes must be unique: {self.sizes}")
+        if exponent <= 0:
+            raise ValueError(f"zipf exponent must be > 0: {exponent}")
+        self.n_requests = int(n_requests)
+        if self.n_requests < 0:
+            raise ValueError(f"n_requests must be >= 0: {n_requests}")
+        self.exponent = float(exponent)
+        self.interval_s = float(interval_s)
+        self.burst_size = max(1, int(burst_size))
+        self.chaos = chaos or ChaosSpec()
+        # The zipf popularity law over size RANKS (list order = rank).
+        weights = np.array(
+            [(r + 1.0) ** -self.exponent for r in range(len(self.sizes))]
+        )
+        probs = weights / weights.sum()
+        # default_rng(seed): the assignment is a pure function of
+        # (seed, sizes, exponent, n) — replays are bitwise-identical.
+        rng = np.random.default_rng(seed)
+        self._assign = rng.choice(
+            len(self.sizes), size=self.n_requests, p=probs
+        )
+        live_bursts = sum(
+            1 for i in self.chaos.burst_requests if i < self.n_requests
+        )
+        self._total = self.n_requests + live_bursts * (self.burst_size - 1)
+        # Per-size emission totals (burst copies included) size each
+        # size's dataset exactly once, up front.
+        totals = [0] * len(self.sizes)
+        for index, s in enumerate(self._assign):
+            copies = (
+                self.burst_size
+                if index in self.chaos.burst_requests else 1
+            )
+            totals[s] += copies
+        self._ds = [
+            SyntheticFlowDataset(
+                size, length=max(1, totals[k]), seed=seed, style=style
+            )
+            for k, size in enumerate(self.sizes)
+        ]
+
+    @staticmethod
+    def size_name(size_hw: Tuple[int, int]) -> str:
+        return f"{size_hw[0]}x{size_hw[1]}"
+
+    def __len__(self) -> int:
+        return self._total
+
+    def size_counts(self) -> Dict[str, int]:
+        """``{size name: request count}`` (burst copies counted with
+        their trigger, matching ``phase_bounds``'s request-not-emission
+        accounting) — what a bench row reports as the measured mix."""
+        counts = {self.size_name(s): 0 for s in self.sizes}
+        for s in self._assign:
+            counts[self.size_name(self.sizes[s])] += 1
+        return counts
+
+    def schedule(self) -> Iterator[TrafficItem]:
+        """Every arrival with its size attribution in the phase field.
+        Burst copies share their trigger's index, phase, and due time."""
+        emitted = [0] * len(self.sizes)
+        due = 0.0
+        for index, s in enumerate(self._assign):
+            s = int(s)
+            due += self.interval_s
+            copies = (
+                self.burst_size
+                if index in self.chaos.burst_requests else 1
+            )
+            for _ in range(copies):
+                sample = self._ds[s].sample(emitted[s])
+                img1, img2 = sample["image1"], sample["image2"]
+                if index in self.chaos.poison_requests:
+                    img1 = np.full(img1.shape, np.nan, np.float32)
+                emitted[s] += 1
+                yield TrafficItem(
+                    index=index, phase=self.size_name(self.sizes[s]),
+                    due_s=due, image1=img1, image2=img2,
+                )
 
     def __iter__(self) -> Iterator[Tuple[float, np.ndarray, np.ndarray]]:
         """``serving/traffic.replay`` compatibility: bare

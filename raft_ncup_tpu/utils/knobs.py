@@ -76,6 +76,12 @@ KNOBS: Tuple[Knob, ...] = (
          "larger chunks amortize the scan at more peak memory."),
     Knob("RAFT_NCUP_VMEM_BYTES", "int", "16777216",
          "Per-core VMEM capacity assumed by kernel band planning."),
+    Knob("RAFT_NCUP_EARLYEXIT", "flag", "0",
+         "Enable in-graph per-sample early exit for converged flow in "
+         "the serving forward (docs/PERF.md 'Early exit')."),
+    Knob("RAFT_NCUP_EARLYEXIT_TOL", "float", "0.05",
+         "Early-exit convergence tolerance: mean |flow delta| per "
+         "sample in LOW-RES pixels below which a lane freezes."),
     # ------------------------------------------------- runtime drivers
     Knob("RAFT_NCUP_PLATFORM", "raw", None,
          "Force the jax platform ('cpu', 'tpu'); the --platform flag's "
@@ -131,6 +137,8 @@ KNOBS: Tuple[Knob, ...] = (
     Knob("BENCH_SKIP_UHD", "flag", "0", "Skip the 4K/UHD bench row."),
     Knob("BENCH_SKIP_PIPELINE", "flag", "0",
          "Skip the iteration-pipelined bench row."),
+    Knob("BENCH_SKIP_EARLYEXIT", "flag", "0",
+         "Skip the early-exit bench row."),
     Knob("BENCH_SKIP_TELEMETRY_COMPARE", "flag", "0",
          "Skip the telemetry-overhead comparison window in the serve "
          "and fleet rows."),
@@ -186,6 +194,18 @@ KNOBS: Tuple[Knob, ...] = (
     Knob("BENCH_PIPELINE_COMPARE", "enabled", "1",
          "Also time the monolithic (single-segment) reference window "
          "('0' skips the comparison)."),
+    Knob("BENCH_EARLYEXIT_TOL", "float", "0.016",
+         "Convergence tolerance the early-exit bench row measures with "
+         "(low-res px; default tuned for the untrained bench weights)."),
+    Knob("BENCH_EARLYEXIT_ITERS", "int", "4",
+         "Iteration budget for the early-exit bench row (both windows). "
+         "Default sized for the untrained bench weights, whose flow "
+         "deltas plateau instead of decaying: converged lanes exit "
+         "around iteration 2, and the quality price grows with every "
+         "budgeted-but-skipped iteration, so a small budget keeps the "
+         "measured EPE delta inside EARLYEXIT_EPE_BUDGET."),
+    Knob("BENCH_EARLYEXIT_REQUESTS", "int", "12",
+         "Mixed-resolution requests the early-exit bench row streams."),
 )
 
 

@@ -38,7 +38,9 @@ def recommend(record: dict) -> list[str]:
             record
         ) + _highres_row_lines(record) + _uhd_row_lines(
             record
-        ) + _pipeline_lines(record) + _fleet_lines(
+        ) + _pipeline_lines(record) + _earlyexit_lines(
+            record
+        ) + _fleet_lines(
             record
         ) + _elasticity_lines(record) + _telemetry_lines(record)
 
@@ -108,6 +110,7 @@ def recommend(record: dict) -> list[str]:
     lines.extend(_highres_row_lines(record))
     lines.extend(_uhd_row_lines(record))
     lines.extend(_pipeline_lines(record))
+    lines.extend(_earlyexit_lines(record))
     lines.extend(_fleet_lines(record))
     lines.extend(_elasticity_lines(record))
     lines.extend(_telemetry_lines(record))
@@ -494,6 +497,70 @@ def _pipeline_lines(record: dict) -> list[str]:
         f"({mono:.3f} pairs/s) by the {MARGIN}x margin; the handoff "
         f"cost ({handoff}) is not yet paying for itself at this "
         "shape/iters"
+    ]
+
+
+def _earlyexit_lines(record: dict) -> list[str]:
+    """Early-exit row (bench.py ``earlyexit_*`` fields; docs/PERF.md
+    "Early exit") — the one speedup verdict this script WILL issue from
+    CPU data: the measured win is a FLOP cut (fewer while_loop trips),
+    honest on every backend, unlike kernel ordering or mesh claims.
+    Absent row → no lines (older records predate it); dirty-or-missing
+    guard counters → the windows are unusable (a recompile means the
+    tolerance leaked into shapes; a transfer means convergence was
+    inspected on the host); EPE over the pinned budget → never enable,
+    regardless of speed; within budget + >= MARGIN throughput win over
+    the full-budget twin → recommend enabling the knob."""
+    pps = record.get("earlyexit_pairs_per_sec")
+    if pps is None:
+        return []
+    transfers = record.get("earlyexit_host_transfers")
+    recompiles = record.get("earlyexit_recompiles")
+    if transfers or recompiles or transfers is None or recompiles is None:
+        return [
+            "earlyexit: INVARIANT VIOLATED (or unrecorded) during the "
+            "adaptive-compute window(s) "
+            f"({transfers if transfers is not None else '?'} implicit "
+            "host transfer(s), "
+            f"{recompiles if recompiles is not None else '?'} "
+            "recompile(s)) — detection must live in-graph with a closed "
+            "executable set; the earlyexit_* numbers are unusable until "
+            "the leak is fixed (docs/ANALYSIS.md)"
+        ]
+    full = record.get("earlyexit_pairs_per_sec_fullbudget")
+    epe = record.get("earlyexit_epe_vs_full")
+    budget = record.get("earlyexit_epe_budget")
+    if not full or epe is None or budget is None:
+        return [
+            "earlyexit: row incomplete (no full-budget twin or parity "
+            "measurement); rerun bench for the full early-exit row "
+            "before judging the knob"
+        ]
+    tol = record.get("earlyexit_tol", "?")
+    execd = record.get("earlyexit_iters_executed_mean", "?")
+    budgeted = record.get("earlyexit_iters_budgeted", "?")
+    if epe > budget:
+        return [
+            f"earlyexit: quality budget EXCEEDED ({epe:.4f} px EPE vs "
+            f"the full-budget twin, budget {budget:.4f}, tol={tol}) — "
+            "do NOT enable RAFT_NCUP_EARLYEXIT at this tolerance; "
+            "tighten RAFT_NCUP_EARLYEXIT_TOL and rerun bench"
+        ]
+    if pps >= MARGIN * full:
+        return [
+            f"earlyexit: VERDICT — enable RAFT_NCUP_EARLYEXIT=1 "
+            f"(RAFT_NCUP_EARLYEXIT_TOL={tol}): {pps:.2f} vs {full:.2f} "
+            f"pairs/s full-budget at matched quality ({epe:.4f} px EPE "
+            f"within {budget:.4f}), executed {execd} of {budgeted} "
+            "budgeted iters mean, invariants clean — the FLOP cut is "
+            "backend-honest, so this CPU verdict carries"
+        ]
+    return [
+        f"earlyexit: keep the knob off — {pps:.2f} vs {full:.2f} "
+        f"pairs/s full-budget misses the {MARGIN}x margin (parity "
+        f"{epe:.4f} px within {budget:.4f}; executed {execd} of "
+        f"{budgeted} budgeted iters mean); per-call overhead is "
+        "swallowing the FLOP cut at this shape mix"
     ]
 
 

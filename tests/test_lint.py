@@ -1744,7 +1744,10 @@ def test_catalog_markdown_covers_registry():
 def test_whole_program_pass_stays_fast():
     """The project pass (one extra AST walk + three cross-module rules)
     must not turn lint.sh into a coffee break: the full tree-wide run,
-    all rules, stays under 5 seconds."""
+    all rules, stays under 5 CPU-seconds. Budgeted on process time, not
+    wall — the pass is single-threaded in-process work, and wall time on
+    a loaded single-core CI host measures the host's OTHER tenants, not
+    a lint regression."""
     import time as _time
 
     from raft_ncup_tpu.analysis.lint import DEFAULT_ALLOWLIST
@@ -1756,9 +1759,9 @@ def test_whole_program_pass_stays_fast():
             "serve.py", "bench.py", "scripts",
         )
     ]
-    t0 = _time.perf_counter()
+    t0 = _time.process_time()
     run_lint(paths, allowlist_path=DEFAULT_ALLOWLIST)
-    assert _time.perf_counter() - t0 < 5.0
+    assert _time.process_time() - t0 < 5.0
 
 
 def test_shipped_tree_lints_clean_via_module_cli():

@@ -125,3 +125,79 @@ class TestConsumptionShapes:
                 "image1", "image2", "due_s", "phase", "index",
             }
             assert isinstance(d["image1"], np.ndarray)
+
+
+class TestMixedResolution:
+    """MixedResolutionTraffic (second slice of ROADMAP item 4): zipf
+    popularity over frame SIZES, with the same determinism, phase
+    attribution, and chaos composition contracts as the step schedule —
+    the early-exit bench row's input (docs/PERF.md "Early exit")."""
+
+    SIZES = [(32, 48), (24, 32), (40, 48)]
+
+    def _t(self, n=20, **kw):
+        from raft_ncup_tpu.traffic import MixedResolutionTraffic
+
+        return MixedResolutionTraffic(self.SIZES, n, seed=5, **kw)
+
+    def test_validation(self):
+        from raft_ncup_tpu.traffic import MixedResolutionTraffic
+
+        with pytest.raises(ValueError, match="needs sizes"):
+            MixedResolutionTraffic([], 4)
+        with pytest.raises(ValueError, match="unique"):
+            MixedResolutionTraffic([(32, 48), (32, 48)], 4)
+        with pytest.raises(ValueError, match="exponent"):
+            MixedResolutionTraffic(self.SIZES, 4, exponent=0.0)
+        with pytest.raises(ValueError, match="n_requests"):
+            MixedResolutionTraffic(self.SIZES, -1)
+
+    def test_deterministic_replay(self):
+        a, b = list(self._t().schedule()), list(self._t().schedule())
+        for x, y in zip(a, b):
+            assert (x.index, x.phase, x.due_s) == (y.index, y.phase,
+                                                   y.due_s)
+            np.testing.assert_array_equal(x.image1, y.image1)
+
+    def test_zipf_mix_and_phase_attribution(self):
+        """Rank-0 (most popular) dominates; every item's frame shape
+        matches its phase name; size_counts sums to n_requests."""
+        t = self._t(n=60)
+        counts = t.size_counts()
+        assert sum(counts.values()) == 60
+        assert counts["32x48"] >= counts["40x48"]  # rank 0 vs rank 2
+        for item in t.schedule():
+            h, w = (int(x) for x in item.phase.split("x"))
+            assert item.image1.shape == (h, w, 3)
+
+    def test_due_times_accumulate(self):
+        t = self._t(n=4, interval_s=0.25)
+        dues = [item.due_s for item in t.schedule()]
+        assert dues == pytest.approx([0.25, 0.5, 0.75, 1.0])
+
+    def test_chaos_composes_on_global_indices(self):
+        from raft_ncup_tpu.resilience.chaos import ChaosSpec
+
+        t = self._t(
+            n=6, chaos=ChaosSpec.parse("burst@2,poison@0"), burst_size=3,
+        )
+        items = list(t.schedule())
+        assert len(items) == len(t) == 6 + 2  # one burst adds 2 copies
+        burst = [i for i in items if i.index == 2]
+        assert len(burst) == 3
+        assert len({b.phase for b in burst}) == 1  # copies share size
+        assert np.isnan(items[0].image1).all()
+        assert np.isfinite(items[0].image2).all()
+
+    def test_consumption_contracts(self):
+        t = self._t(n=3)
+        triples = list(t)
+        rich = list(t.schedule())
+        assert len(triples) == 3
+        for (due, i1, _i2), item in zip(triples, rich):
+            assert due == item.due_s
+            np.testing.assert_array_equal(i1, item.image1)
+        for d in t.items():
+            assert set(d) == {
+                "image1", "image2", "due_s", "phase", "index",
+            }
